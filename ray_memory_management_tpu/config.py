@@ -1,0 +1,119 @@
+"""Typed, env-overridable configuration flags.
+
+Mirrors the reference's ``RAY_CONFIG(type, name, default)`` macro system
+(src/ray/common/ray_config.h:46-58, defaults in src/ray/common/ray_config_def.h):
+every flag has a type, a default, and an environment override spelled
+``RMT_<NAME>``. Unlike the reference's C++ singleton, this is a plain Python
+dataclass-like registry so tests can construct scoped configs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAG_DEFS: Dict[str, tuple] = {}
+
+
+def _flag(name: str, typ, default, doc: str = ""):
+    _FLAG_DEFS[name] = (typ, default, doc)
+    return default
+
+
+# --- object store / data plane (reference: ray_config_def.h) -----------------
+_flag("max_direct_call_object_size", int, 100 * 1024,
+      "Objects <= this are inlined in task replies / the in-process memory "
+      "store instead of the shared-memory store (ray_config_def.h:181).")
+_flag("task_rpc_inlined_bytes_limit", int, 10 * 1024 * 1024,
+      "Total bytes of args inlined into a task submission (ray_config_def.h:424).")
+_flag("object_store_memory", int, 512 * 1024 * 1024,
+      "Per-node shared-memory store capacity in bytes.")
+_flag("object_store_fallback_directory", str, "/tmp/rmt_spill",
+      "Directory for spilled objects (external storage).")
+_flag("min_spilling_size", int, 1 * 1024 * 1024,
+      "Spill batches of at least this many bytes (ray_config_def.h:495; the "
+      "reference default is 100 MiB, scaled down for single-host stores).")
+_flag("object_spilling_threshold", float, 0.8,
+      "Start spilling when the store passes this fraction full "
+      "(ray_config_def.h:499).")
+_flag("max_io_workers", int, 2,
+      "Concurrent spill/restore IO threads (ray_config_def.h:489; default 4).")
+_flag("object_manager_chunk_size", int, 5 * 1024 * 1024,
+      "Chunk size for inter-node object push/pull (ray_config_def.h:300).")
+
+# --- scheduling --------------------------------------------------------------
+_flag("scheduler_spread_threshold", float, 0.5,
+      "Hybrid policy: pack onto the local/low-index nodes until utilization "
+      "passes this, then spread (hybrid_scheduling_policy.h:48).")
+_flag("worker_prestart_count", int, 2,
+      "Workers to prestart per node at startup (worker_pool.h prestart).")
+_flag("max_workers_per_node", int, 8,
+      "Upper bound on pooled workers per node.")
+_flag("worker_lease_timeout_s", float, 30.0,
+      "How long a task waits for a worker lease before erroring.")
+
+# --- fault tolerance ---------------------------------------------------------
+_flag("num_heartbeats_timeout", int, 30,
+      "Missed heartbeats before a node is declared dead "
+      "(gcs_heartbeat_manager.cc:29).")
+_flag("heartbeat_interval_s", float, 0.5, "Node heartbeat period.")
+_flag("task_max_retries", int, 4,
+      "Default retries for normal tasks (remote_function.py:161-166).")
+_flag("actor_max_restarts", int, 0, "Default actor restarts.")
+
+# --- tpu / accelerator -------------------------------------------------------
+_flag("tpu_chips_per_host", int, 4,
+      "Chips exposed per host-process (v4/v5 host has 4; the worker is a "
+      "host-process — SURVEY.md §7 design stance).")
+_flag("tpu_visible_chips_env", str, "TPU_VISIBLE_CHIPS",
+      "Env var used to scope chips to a leased worker, the TPU analog of "
+      "CUDA_VISIBLE_DEVICES handling (_raylet.pyx:563, _private/utils.py:349).")
+
+# --- misc --------------------------------------------------------------------
+_flag("event_stats", bool, True,
+      "Collect per-handler event-loop stats (src/ray/common/event_stats.cc).")
+_flag("log_to_driver", bool, True, "Forward worker logs to the driver.")
+
+
+def _coerce(typ, raw: str):
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+class Config:
+    """A scoped snapshot of all flags, with ``RMT_<NAME>`` env overrides
+    applied at construction time (the reference reads ``RAY_<name>`` once at
+    process start, ray_config.h:58)."""
+
+    def __init__(self, **overrides: Any):
+        for name, (typ, default, _doc) in _FLAG_DEFS.items():
+            env = os.environ.get(f"RMT_{name}")
+            value = _coerce(typ, env) if env is not None else default
+            setattr(self, name, value)
+        for k, v in overrides.items():
+            if k not in _FLAG_DEFS:
+                raise ValueError(f"unknown config flag: {k}")
+            setattr(self, k, v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _FLAG_DEFS}
+
+    @staticmethod
+    def flag_docs() -> Dict[str, str]:
+        return {name: doc for name, (_t, _d, doc) in _FLAG_DEFS.items()}
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_global_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

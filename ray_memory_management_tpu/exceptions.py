@@ -1,0 +1,65 @@
+"""Exception hierarchy, mirroring the reference's python/ray/exceptions.py."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RmtError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RmtError):
+    """A task raised an exception; re-raised at ``get()`` on the caller.
+
+    Mirrors RayTaskError (python/ray/exceptions.py): carries the remote
+    traceback string so the driver sees where the task failed.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException | None = None,
+                 remote_tb: str | None = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_tb = remote_tb or (
+            "".join(traceback.format_exception(cause)) if cause else ""
+        )
+        super().__init__(
+            f"task {function_name} failed:\n{self.remote_tb}"
+        )
+
+
+class ActorError(RmtError):
+    """Raised when calling a dead/unreachable actor (RayActorError)."""
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RmtError):
+    """The worker process executing the task died (WorkerCrashedError)."""
+
+
+class ObjectLostError(RmtError):
+    """Object value unavailable and lineage reconstruction failed
+    (ObjectLostError / ObjectReconstructionFailedError)."""
+
+    def __init__(self, object_id_hex: str, msg: str = ""):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"object {object_id_hex} lost. {msg}")
+
+
+class ObjectStoreFullError(RmtError):
+    """Store full and spilling could not make room (ObjectStoreFullError)."""
+
+
+class GetTimeoutError(RmtError, TimeoutError):
+    """``get(timeout=...)`` expired (python/ray/exceptions.py GetTimeoutError)."""
+
+
+class RuntimeEnvSetupError(RmtError):
+    pass
+
+
+class PlacementGroupError(RmtError):
+    pass

@@ -1,0 +1,139 @@
+"""Unique identifiers for tasks, objects, actors, nodes, jobs and placement groups.
+
+Design follows the reference's 128-bit binary IDs with embedded provenance
+(reference: src/ray/design_docs/id_specification.md, src/ray/common/id.h):
+
+- A ``TaskID`` embeds the job; an ``ObjectID`` of a task return embeds the
+  producing ``TaskID`` plus a return index, so lineage can be recovered from the
+  ID alone (the owner resubmits the producing task on loss — reference
+  src/ray/core_worker/object_recovery_manager.h:41).
+- IDs are fixed-size ``bytes`` wrapped in typed classes; hashing/equality is by
+  value so they can key dicts and travel through pickle cheaply.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ID_SIZE = 16  # 128-bit, as in the reference (id_specification.md)
+
+# Number of trailing bytes of an ObjectID that encode the return index. The
+# reference packs the index into the ObjectID the same way
+# (src/ray/common/id.h ObjectID::FromIndex).
+_INDEX_BYTES = 4
+
+
+class BaseID:
+    """Value-typed 128-bit identifier."""
+
+    __slots__ = ("_binary", "_hash")
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != _ID_SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {_ID_SIZE} bytes, got {binary!r}"
+            )
+        self._binary = binary
+        self._hash = hash(binary)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(_ID_SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * _ID_SIZE)
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def is_nil(self) -> bool:
+        return self._binary == b"\x00" * _ID_SIZE
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __lt__(self, other):
+        return self._binary < other._binary
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._binary.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class JobID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def for_task(cls, job_id: JobID) -> "TaskID":
+        """Fresh task id carrying the job in its first 4 bytes.
+
+        The trailing ``_INDEX_BYTES`` are zero so that return-object IDs can
+        embed a return index there and still map back to this task via
+        :meth:`ObjectID.task_id`.
+        """
+        with cls._lock:
+            cls._counter += 1
+        return cls(
+            job_id.binary()[:4]
+            + os.urandom(_ID_SIZE - 4 - _INDEX_BYTES)
+            + b"\x00" * _INDEX_BYTES
+        )
+
+
+class ObjectID(BaseID):
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """Deterministic ID of the ``index``-th return of ``task_id``.
+
+        Mirrors ObjectID::FromIndex in the reference: lineage reconstruction
+        re-derives the same IDs when the task is re-executed.
+        """
+        prefix = task_id.binary()[: _ID_SIZE - _INDEX_BYTES]
+        return cls(prefix + index.to_bytes(_INDEX_BYTES, "little"))
+
+    @classmethod
+    def for_put(cls) -> "ObjectID":
+        """Random ID for a driver/worker ``put`` (no lineage)."""
+        return cls(os.urandom(_ID_SIZE))
+
+    def task_id(self) -> TaskID:
+        """The producing task's ID prefix (valid only for return objects)."""
+        return TaskID(self._binary[: _ID_SIZE - _INDEX_BYTES] + b"\x00" * _INDEX_BYTES)
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._binary[_ID_SIZE - _INDEX_BYTES :], "little")

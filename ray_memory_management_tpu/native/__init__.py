@@ -1,0 +1,1 @@
+from .shm_client import ShmStore, ShmStoreFullError  # noqa: F401

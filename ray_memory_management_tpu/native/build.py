@@ -1,0 +1,40 @@
+"""Lazy build of the native shared-memory store library.
+
+The reference builds its C++ runtime with bazel (WORKSPACE, BUILD.bazel); here
+the native pieces are small enough that a direct g++ invocation, cached next to
+the source and keyed on the source mtime, keeps the install story to "import
+the package". A Makefile with the same flags lives alongside for manual builds.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+_LIBS = {
+    "shmstore": ["shmstore.cpp"],
+}
+
+
+def lib_path(name: str = "shmstore") -> str:
+    """Return the path to the built .so, compiling it if stale or missing."""
+    sources = [os.path.join(_HERE, s) for s in _LIBS[name]]
+    out = os.path.join(_HERE, f"lib{name}.so")
+    with _LOCK:
+        if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(src) for src in sources
+        ):
+            return out
+        tmp = out + f".tmp.{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
+            "-Wall", "-Werror",
+            *sources, "-o", tmp, "-lpthread", "-lrt",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)  # atomic wrt concurrent builders
+    return out

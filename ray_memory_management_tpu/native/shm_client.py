@@ -1,0 +1,165 @@
+"""ctypes client for the native shared-memory object store.
+
+The plasma-client analog (reference: src/ray/object_manager/plasma/client.cc:240
+— mmap-cached zero-copy buffer access). Each process opens the store file once
+and maps it once; ``get`` returns a memoryview directly into the mapping.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import List, Optional, Tuple
+
+from .build import lib_path
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(lib_path("shmstore"))
+    lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.store_create.restype = ctypes.c_int64
+    lib.store_open.argtypes = [ctypes.c_char_p]
+    lib.store_open.restype = ctypes.c_int64
+    lib.store_close.argtypes = [ctypes.c_int64]
+    lib.store_unlink.argtypes = [ctypes.c_char_p]
+    lib.store_unlink.restype = ctypes.c_int
+    lib.obj_create.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64]
+    lib.obj_create.restype = ctypes.c_int64
+    lib.obj_seal.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.obj_seal.restype = ctypes.c_int
+    lib.obj_get.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.obj_get.restype = ctypes.c_int
+    lib.obj_release.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.obj_release.restype = ctypes.c_int
+    lib.obj_delete.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.obj_delete.restype = ctypes.c_int
+    lib.obj_contains.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.obj_contains.restype = ctypes.c_int
+    lib.store_usage.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.evict_candidates.argtypes = [
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.evict_candidates.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class ShmStoreFullError(Exception):
+    pass
+
+
+class ShmStore:
+    """One named store; open with ``create=True`` exactly once per store."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        lib = _load()
+        self.name = name
+        if create:
+            self.handle = lib.store_create(name.encode(), capacity)
+        else:
+            self.handle = lib.store_open(name.encode())
+        if self.handle < 0:
+            raise OSError(f"failed to open shm store {name}: rc={self.handle}")
+        # Map the same file for zero-copy python-side access.
+        self._file = open(f"/dev/shm{name}", "r+b")
+        self._map = mmap.mmap(self._file.fileno(), 0)
+        self._mv = memoryview(self._map)
+        self._closed = False
+
+    # -- object lifecycle -----------------------------------------------------
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate; returns a writable view. Seal before readers can get it."""
+        rc = _load().obj_create(self.handle, object_id, size)
+        if rc == 0:
+            raise ShmStoreFullError(
+                f"store {self.name} full allocating {size} bytes"
+            )
+        if rc == -2:
+            raise ValueError(f"object {object_id.hex()} already exists")
+        if rc < 0:
+            raise OSError(f"obj_create failed rc={rc}")
+        return self._mv[rc : rc + size]
+
+    def seal(self, object_id: bytes) -> None:
+        rc = _load().obj_seal(self.handle, object_id)
+        if rc != 0:
+            raise OSError(f"seal({object_id.hex()}) failed rc={rc}")
+
+    def get(self, object_id: bytes, inc_ref: bool = True) -> Optional[memoryview]:
+        """Zero-copy read view of a sealed object, or None if absent."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _load().obj_get(
+            self.handle, object_id, ctypes.byref(off), ctypes.byref(size),
+            1 if inc_ref else 0,
+        )
+        if rc == -1:
+            return None
+        if rc == -2:
+            return None  # created but unsealed: not visible yet
+        return self._mv[off.value : off.value + size.value]
+
+    def release(self, object_id: bytes) -> None:
+        _load().obj_release(self.handle, object_id)
+
+    def delete(self, object_id: bytes) -> bool:
+        """True if freed; False while readers still hold references."""
+        rc = _load().obj_delete(self.handle, object_id)
+        return rc == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        return _load().obj_contains(self.handle, object_id) == 1
+
+    # -- store-level ----------------------------------------------------------
+    def usage(self) -> Tuple[int, int, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        _load().store_usage(
+            self.handle, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(n)
+        )
+        return used.value, cap.value, n.value
+
+    def evict_candidates(self, need_bytes: int, max_out: int = 256) -> List[bytes]:
+        buf = ctypes.create_string_buffer(16 * max_out)
+        n = _load().evict_candidates(self.handle, need_bytes, buf, max_out)
+        return [buf.raw[16 * i : 16 * (i + 1)] for i in range(max(n, 0))]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mv.release()
+            self._map.close()
+        except BufferError:
+            # Zero-copy views handed to callers are still alive; the mapping
+            # stays until they are garbage-collected (the reference's client
+            # mmap cache has the same lifetime behavior, plasma/client.cc:240).
+            pass
+        self._file.close()
+        _load().store_close(self.handle)
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        _load().store_unlink(name.encode())
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,459 @@
+// Shared-memory object store: the TPU-framework equivalent of the reference's
+// plasma store (reference: src/ray/object_manager/plasma/{store.h,plasma_allocator.h,
+// object_lifecycle_manager.h,eviction_policy.h}).
+//
+// Design differences from plasma, chosen for the one-process-per-TPU-host model:
+//  - No store server process or Unix-socket protocol (plasma runs a thread in
+//    the raylet and speaks flatbuffers over a socket, store.h / protocol.h).
+//    Instead, ALL state lives inside one shm mapping — an object table and a
+//    boundary-tag heap — guarded by a process-shared robust pthread mutex, so
+//    any process on the host can create/seal/get objects directly at memory
+//    speed. Crashed clients cannot wedge the lock (robust mutex + consistent).
+//  - dlmalloc-over-mmap (dlmalloc.cc) is replaced by a first-fit boundary-tag
+//    allocator with coalescing; payloads are 64-byte aligned for zero-copy
+//    numpy/XLA host-buffer views.
+//  - Eviction: callers ask for LRU candidates (eviction_policy.h:105 analog)
+//    and spill them via IO threads before deleting (local_object_manager.h:99).
+//
+// Object lifecycle mirrors plasma: CREATED -> SEALED -> (refcounted) -> DELETED
+// (object_lifecycle_manager.h:101). Get on an unsealed object fails; delete
+// only succeeds at refcount zero.
+
+#include <cerrno>
+#include <cstdint>
+#include <utility>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x524d545354524531ull;  // "RMTSTRE1"
+constexpr uint32_t kNumEntries = 1 << 16;           // open-addressed table
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kBlockHeader = 64;  // keeps payloads 64B-aligned
+
+enum ObjState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Entry {
+  uint8_t id[16];
+  uint64_t offset;  // payload offset from mapping base
+  uint64_t size;    // payload size
+  uint32_t state;
+  int32_t refcount;
+  uint64_t lru;  // last-touch tick (eviction_policy.h LRU analog)
+};
+
+struct Block {
+  uint64_t size;       // total block size including header, multiple of 64
+  uint64_t prev_size;  // size of the previous block (0 for first)
+  uint32_t free;
+  uint32_t pad_[11];   // pad header to 64 bytes
+};
+static_assert(sizeof(Block) == kBlockHeader, "block header must be 64B");
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;     // total file size
+  uint64_t heap_offset;  // first block offset
+  uint64_t heap_size;
+  uint64_t used;         // payload bytes in live (created|sealed) objects
+  uint64_t num_objects;
+  uint64_t lru_clock;
+  pthread_mutex_t mutex;
+};
+
+struct Store {
+  uint8_t* base = nullptr;
+  uint64_t capacity = 0;
+  int fd = -1;
+  bool valid = false;
+};
+
+constexpr int kMaxStores = 64;
+Store g_stores[kMaxStores];
+
+Header* header(Store& s) { return reinterpret_cast<Header*>(s.base); }
+Entry* table(Store& s) {
+  return reinterpret_cast<Entry*>(s.base + sizeof(Header));
+}
+Block* block_at(Store& s, uint64_t off) {
+  return reinterpret_cast<Block*>(s.base + off);
+}
+
+uint64_t table_bytes() { return sizeof(Entry) * (uint64_t)kNumEntries; }
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a over the 16-byte id
+  for (int i = 0; i < 16; i++) {
+    h ^= id[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Lock with robust-mutex recovery: if the owner died mid-critical-section we
+// mark the state consistent and continue (object table stays valid because all
+// mutations below are ordered to be crash-tolerant at entry granularity).
+int lock(Store& s) {
+  int rc = pthread_mutex_lock(&header(s)->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&header(s)->mutex);
+    return 0;
+  }
+  return rc;
+}
+void unlock(Store& s) { pthread_mutex_unlock(&header(s)->mutex); }
+
+// Find entry for id; returns nullptr if absent. Caller holds the lock.
+Entry* find(Store& s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t h = hash_id(id) & (kNumEntries - 1);
+  for (uint32_t probe = 0; probe < kNumEntries; probe++) {
+    Entry& e = t[(h + probe) & (kNumEntries - 1)];
+    if (e.state == kEmpty) return nullptr;
+    if (e.state != kTombstone && memcmp(e.id, id, 16) == 0) return &e;
+  }
+  return nullptr;
+}
+
+Entry* find_slot(Store& s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t h = hash_id(id) & (kNumEntries - 1);
+  Entry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kNumEntries; probe++) {
+    Entry& e = t[(h + probe) & (kNumEntries - 1)];
+    if (e.state == kEmpty) return first_tomb ? first_tomb : &e;
+    if (e.state == kTombstone) {
+      if (!first_tomb) first_tomb = &e;
+      continue;
+    }
+    if (memcmp(e.id, id, 16) == 0) return nullptr;  // already present
+  }
+  return first_tomb;
+}
+
+uint64_t round_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+// First-fit allocation over the boundary-tag heap. Returns payload offset or 0.
+uint64_t heap_alloc(Store& s, uint64_t payload_size) {
+  Header* h = header(s);
+  uint64_t need = round_up(payload_size, kAlign) + kBlockHeader;
+  uint64_t off = h->heap_offset;
+  uint64_t end = h->heap_offset + h->heap_size;
+  while (off < end) {
+    Block* b = block_at(s, off);
+    if (b->free && b->size >= need) {
+      uint64_t remainder = b->size - need;
+      if (remainder >= kBlockHeader + kAlign) {
+        // split: carve the tail into a new free block
+        b->size = need;
+        Block* tail = block_at(s, off + need);
+        tail->size = remainder;
+        tail->prev_size = need;
+        tail->free = 1;
+        uint64_t after = off + need + remainder;
+        if (after < end) block_at(s, after)->prev_size = remainder;
+      }
+      b->free = 0;
+      return off + kBlockHeader;
+    }
+    off += b->size;
+  }
+  return 0;
+}
+
+void heap_free(Store& s, uint64_t payload_off) {
+  Header* h = header(s);
+  uint64_t off = payload_off - kBlockHeader;
+  Block* b = block_at(s, off);
+  b->free = 1;
+  uint64_t end = h->heap_offset + h->heap_size;
+  // coalesce with next
+  uint64_t next_off = off + b->size;
+  if (next_off < end) {
+    Block* n = block_at(s, next_off);
+    if (n->free) {
+      b->size += n->size;
+      uint64_t after = off + b->size;
+      if (after < end) block_at(s, after)->prev_size = b->size;
+    }
+  }
+  // coalesce with prev
+  if (b->prev_size != 0) {
+    Block* p = block_at(s, off - b->prev_size);
+    if (p->free) {
+      p->size += b->size;
+      uint64_t after = off - b->prev_size + p->size;
+      if (after < end) block_at(s, after)->prev_size = p->size;
+    }
+  }
+}
+
+int64_t register_store(Store&& st) {
+  for (int i = 0; i < kMaxStores; i++) {
+    if (!g_stores[i].valid) {
+      g_stores[i] = st;
+      g_stores[i].valid = true;
+      return i;
+    }
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or truncate) a store named `name` with total file size `capacity`.
+int64_t store_create(const char* name, uint64_t capacity) {
+  int fd = shm_open(name, O_CREAT | O_RDWR | O_TRUNC, 0600);
+  if (fd < 0) return -1;
+  uint64_t meta = sizeof(Header) + table_bytes();
+  if (capacity < meta + (1 << 20)) capacity = meta + (1 << 20);
+  capacity = round_up(capacity, 4096);
+  if (ftruncate(fd, (off_t)capacity) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* base =
+      mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return -1;
+  }
+  Store st;
+  st.base = (uint8_t*)base;
+  st.capacity = capacity;
+  st.fd = fd;
+  Header* h = header(st);
+  memset(h, 0, sizeof(Header));
+  memset(table(st), 0, table_bytes());
+  h->capacity = capacity;
+  h->heap_offset = round_up(sizeof(Header) + table_bytes(), kAlign);
+  h->heap_size = capacity - h->heap_offset;
+  Block* first = block_at(st, h->heap_offset);
+  first->size = h->heap_size & ~(kAlign - 1);
+  first->prev_size = 0;
+  first->free = 1;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  __sync_synchronize();
+  h->magic = kMagic;
+  return register_store(std::move(st));
+}
+
+int64_t store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -1;
+  struct stat sb;
+  if (fstat(fd, &sb) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* base = mmap(nullptr, (uint64_t)sb.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return -1;
+  }
+  Store st;
+  st.base = (uint8_t*)base;
+  st.capacity = (uint64_t)sb.st_size;
+  st.fd = fd;
+  if (header(st)->magic != kMagic) {
+    munmap(base, st.capacity);
+    close(fd);
+    return -2;
+  }
+  return register_store(std::move(st));
+}
+
+void store_close(int64_t h) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return;
+  munmap(g_stores[h].base, g_stores[h].capacity);
+  close(g_stores[h].fd);
+  g_stores[h].valid = false;
+}
+
+int store_unlink(const char* name) { return shm_unlink(name); }
+
+// Allocate an object; returns payload offset, or 0 if the heap is full,
+// -2 if the id already exists, -1 on bad handle.
+int64_t obj_create(int64_t h, const uint8_t* id, uint64_t size) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* slot = find_slot(s, id);
+  if (slot == nullptr) {
+    unlock(s);
+    return -2;
+  }
+  uint64_t off = heap_alloc(s, size);
+  if (off == 0) {
+    unlock(s);
+    return 0;
+  }
+  memcpy(slot->id, id, 16);
+  slot->offset = off;
+  slot->size = size;
+  slot->state = kCreated;
+  slot->refcount = 0;
+  slot->lru = ++header(s)->lru_clock;
+  header(s)->used += size;
+  header(s)->num_objects += 1;
+  unlock(s);
+  return (int64_t)off;
+}
+
+int obj_seal(int64_t h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* e = find(s, id);
+  int rc = 0;
+  if (!e) {
+    rc = -1;
+  } else if (e->state == kSealed) {
+    rc = -3;  // double seal
+  } else {
+    e->state = kSealed;
+    e->lru = ++header(s)->lru_clock;
+  }
+  unlock(s);
+  return rc;
+}
+
+// Look up a sealed object; bumps refcount when inc_ref != 0.
+int obj_get(int64_t h, const uint8_t* id, uint64_t* off, uint64_t* size,
+            int inc_ref) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* e = find(s, id);
+  int rc;
+  if (!e) {
+    rc = -1;
+  } else if (e->state != kSealed) {
+    rc = -2;
+  } else {
+    *off = e->offset;
+    *size = e->size;
+    if (inc_ref) e->refcount++;
+    e->lru = ++header(s)->lru_clock;
+    rc = 0;
+  }
+  unlock(s);
+  return rc;
+}
+
+int obj_release(int64_t h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* e = find(s, id);
+  int rc = 0;
+  if (!e || e->refcount <= 0) {
+    rc = -1;
+  } else {
+    e->refcount--;
+  }
+  unlock(s);
+  return rc;
+}
+
+// Delete (or abort an unsealed create). Fails with -2 while mapped by readers.
+int obj_delete(int64_t h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* e = find(s, id);
+  int rc = 0;
+  if (!e) {
+    rc = -1;
+  } else if (e->refcount > 0) {
+    rc = -2;
+  } else {
+    heap_free(s, e->offset);
+    header(s)->used -= e->size;
+    header(s)->num_objects -= 1;
+    e->state = kTombstone;
+  }
+  unlock(s);
+  return rc;
+}
+
+int obj_contains(int64_t h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* e = find(s, id);
+  int rc = (e && e->state == kSealed) ? 1 : 0;
+  unlock(s);
+  return rc;
+}
+
+void store_usage(int64_t h, uint64_t* used, uint64_t* capacity,
+                 uint64_t* nobjs) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return;
+  *used = header(s)->used;
+  *capacity = header(s)->heap_size;
+  *nobjs = header(s)->num_objects;
+  unlock(s);
+}
+
+// Collect up to max_out LRU sealed, unreferenced objects totalling >= need
+// bytes (the spill-candidate selection, eviction_policy.h:105,160 analog).
+// Writes ids consecutively into out_ids (16 bytes each); returns the count.
+int evict_candidates(int64_t h, uint64_t need, uint8_t* out_ids, int max_out) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return -1;
+  Store& s = g_stores[h];
+  if (lock(s) != 0) return -1;
+  Entry* t = table(s);
+  int count = 0;
+  uint64_t got = 0;
+  while (count < max_out && got < need) {
+    Entry* best = nullptr;
+    for (uint32_t i = 0; i < kNumEntries; i++) {
+      Entry& e = t[i];
+      if (e.state != kSealed || e.refcount != 0) continue;
+      bool taken = false;
+      for (int j = 0; j < count; j++) {
+        if (memcmp(out_ids + 16 * j, e.id, 16) == 0) {
+          taken = true;
+          break;
+        }
+      }
+      if (taken) continue;
+      if (!best || e.lru < best->lru) best = &e;
+    }
+    if (!best) break;
+    memcpy(out_ids + 16 * count, best->id, 16);
+    got += best->size;
+    count++;
+  }
+  unlock(s);
+  return count;
+}
+
+uint64_t store_capacity(int64_t h) {
+  if (h < 0 || h >= kMaxStores || !g_stores[h].valid) return 0;
+  return header(g_stores[h])->heap_size;
+}
+
+}  // extern "C"

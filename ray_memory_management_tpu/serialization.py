@@ -1,0 +1,143 @@
+"""Object serialization: pickle5 with out-of-band buffers in a framed envelope.
+
+Mirrors the reference's SerializationContext (python/ray/_private/serialization.py:89,363,411):
+values are pickled with protocol 5; large contiguous buffers (numpy arrays,
+bytes) travel out-of-band and are laid out 64-byte aligned after the pickle
+stream, so deserializing from a shared-memory mapping yields **zero-copy numpy
+views onto the store** (serialization.py:341 in the reference).
+
+jax.Array values are converted to host numpy on serialize and rebuilt with
+``jax.numpy.asarray`` on deserialize (device placement is the consumer's
+choice; a device-buffer fast path lives in core/object_store.py). jax is
+imported lazily so plain workers never pay its import cost.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import sys
+from typing import Any, List, Tuple
+
+import msgpack
+
+_MAGIC = b"RMT1"
+_ALIGN = 64
+
+
+def _is_jax_array(value) -> bool:
+    mod = type(value).__module__
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return False
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(value, jax.Array)
+
+
+class _JaxAwarePickler(pickle.Pickler):
+    """Pickler that ships jax.Arrays as host numpy + a rebuild marker."""
+
+    def reducer_override(self, obj):
+        if _is_jax_array(obj):
+            import numpy as np
+
+            return (_rebuild_jax_array, (np.asarray(obj),))
+        return NotImplemented
+
+
+def _rebuild_jax_array(np_value):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np_value)
+
+
+def _align(offset: int) -> int:
+    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """A serialized value: header + pickle stream + aligned raw buffers."""
+
+    __slots__ = ("_header", "_pickled", "_buffers", "total_size")
+
+    def __init__(self, header: bytes, pickled: bytes, buffers: List[memoryview]):
+        self._header = header
+        self._pickled = pickled
+        self._buffers = buffers
+        meta = msgpack.unpackb(header[len(_MAGIC) + 8 :])
+        self.total_size = meta["total"]
+
+    def write_into(self, dest: memoryview) -> None:
+        """Write the full envelope into ``dest`` (a store allocation)."""
+        pos = 0
+        for part in (self._header, self._pickled):
+            dest[pos : pos + len(part)] = part
+            pos += len(part)
+        for buf in self._buffers:
+            pos = _align(pos)
+            n = buf.nbytes
+            dest[pos : pos + n] = buf.cast("B") if buf.format != "B" or buf.ndim != 1 else buf
+            pos += n
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    stream = io.BytesIO()
+    raw_buffers: List[pickle.PickleBuffer] = []
+    pickler = _JaxAwarePickler(
+        stream, protocol=5, buffer_callback=raw_buffers.append
+    )
+    pickler.dump(value)
+    pickled = stream.getvalue()
+
+    views: List[memoryview] = []
+    sizes: List[int] = []
+    for pb in raw_buffers:
+        mv = pb.raw()
+        views.append(mv)
+        sizes.append(mv.nbytes)
+
+    # Header: MAGIC | u64 meta_len | msgpack{pickle_off, pickle_len, buf_sizes, total}
+    # Two-pass: meta length depends on total, which depends on meta length; the
+    # meta is small so iterate to fixed point (at most twice).
+    meta = {"pickle_len": len(pickled), "buf_sizes": sizes, "total": 0}
+    for _ in range(3):
+        packed = msgpack.packb(meta)
+        header_len = len(_MAGIC) + 8 + len(packed)
+        pos = header_len + len(pickled)
+        for s in sizes:
+            pos = _align(pos) + s
+        if meta["total"] == pos:
+            break
+        meta["total"] = pos
+    header = _MAGIC + len(packed).to_bytes(8, "little") + packed
+    return SerializedObject(header, pickled, views)
+
+
+def deserialize(data: memoryview | bytes) -> Any:
+    mv = memoryview(data)
+    if bytes(mv[: len(_MAGIC)]) != _MAGIC:
+        raise ValueError("corrupt object envelope (bad magic)")
+    meta_len = int.from_bytes(mv[len(_MAGIC) : len(_MAGIC) + 8], "little")
+    meta_start = len(_MAGIC) + 8
+    meta = msgpack.unpackb(mv[meta_start : meta_start + meta_len])
+    pos = meta_start + meta_len
+    pickled = mv[pos : pos + meta["pickle_len"]]
+    pos += meta["pickle_len"]
+    buffers: List[memoryview] = []
+    for size in meta["buf_sizes"]:
+        pos = _align(pos)
+        buffers.append(mv[pos : pos + size])  # zero-copy view into the mapping
+        pos += size
+    return pickle.loads(pickled, buffers=buffers)
+
+
+def dumps(value: Any) -> bytes:
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(data)
