@@ -1,0 +1,16 @@
+"""ray_memory_management_tpu: a TPU-native distributed runtime with the
+capability surface of the reference (tasks, actors, objects, placement groups,
+collectives, Train/Tune/Data/Serve-style libraries), re-architected for
+JAX/XLA/Pallas — see SURVEY.md for the blueprint."""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    init, shutdown, is_initialized, remote, get, put, wait, kill, cancel,
+    get_actor, method, ObjectRef, nodes, cluster_resources,
+    available_resources, timeline,
+)
+from .exceptions import (  # noqa: F401
+    RmtError, TaskError, ActorError, ActorDiedError, WorkerCrashedError,
+    ObjectLostError, ObjectStoreFullError, GetTimeoutError,
+)

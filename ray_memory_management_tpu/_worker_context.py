@@ -1,0 +1,46 @@
+"""Process-global context: is this process a driver or a worker?
+
+The reference keeps a global worker singleton with a mode flag
+(python/ray/_private/worker.py global_worker). Here the public API consults
+this module to route calls either to the in-process driver Runtime or to the
+worker's pipe-backed proxy.
+"""
+
+from __future__ import annotations
+
+_proxy = None
+_runtime = None
+
+
+def set_proxy(proxy) -> None:
+    global _proxy
+    _proxy = proxy
+
+
+def get_proxy():
+    return _proxy
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def get_runtime():
+    return _runtime
+
+
+def in_worker() -> bool:
+    return _proxy is not None
+
+
+def backend():
+    """The submission backend for the current process (driver runtime or
+    worker proxy). Raises if neither is initialized."""
+    if _proxy is not None:
+        return _proxy
+    if _runtime is not None:
+        return _runtime
+    raise RuntimeError(
+        "not initialized: call ray_memory_management_tpu.init() first"
+    )

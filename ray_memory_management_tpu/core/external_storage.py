@@ -1,0 +1,62 @@
+"""External (spill) storage tiers below the shared-memory store.
+
+Mirrors python/ray/_private/external_storage.py: an ``ExternalStorage`` ABC
+(reference :72) with a filesystem implementation (:243). Spill files carry the
+serialized envelope verbatim, so restore is a straight copy back into the
+store. Cloud storage (GCS/S3) plugs in by subclassing ``ExternalStorage`` —
+the reference uses smart_open for this (:204); here a URI-prefix registry
+selects the implementation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class ExternalStorage:
+    def spill(self, object_id: bytes, data: memoryview) -> str:
+        """Persist and return an opaque URL for restore."""
+        raise NotImplementedError
+
+    def restore(self, object_id: bytes, url: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, url: str) -> None:
+        raise NotImplementedError
+
+
+class FileSystemStorage(ExternalStorage):
+    """One file per spilled object under ``directory`` (reference :243; the
+    reference also packs small objects into fused files — elided here because
+    min_spilling_size batching already amortizes file overhead)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def spill(self, object_id: bytes, data: memoryview) -> str:
+        path = os.path.join(self.directory, object_id.hex())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return path
+
+    def restore(self, object_id: bytes, url: str) -> bytes:
+        with open(url, "rb") as f:
+            return f.read()
+
+    def delete(self, url: str) -> None:
+        try:
+            os.remove(url)
+        except FileNotFoundError:
+            pass
+
+
+def storage_for_uri(uri: str) -> ExternalStorage:
+    if uri.startswith("file://"):
+        return FileSystemStorage(uri[len("file://"):])
+    if "://" not in uri:
+        return FileSystemStorage(uri)
+    raise ValueError(f"unsupported spill storage uri: {uri}")

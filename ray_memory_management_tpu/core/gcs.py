@@ -1,0 +1,216 @@
+"""Global control state — the GCS equivalent (src/ray/gcs/gcs_server/).
+
+Holds cluster-level state only, as in the reference: node membership +
+liveness (gcs_node_manager.h:36, gcs_heartbeat_manager.h:36), the actor
+directory (gcs_actor_manager.h:214), placement groups
+(gcs_placement_group_manager.h:173), jobs, an internal KV
+(gcs_kv_manager.h), pubsub channels (src/ray/pubsub/), and the object
+directory (ownership_based_object_directory.h — centralized here because the
+driver owns all objects in the single-host round-1 model).
+
+In-process and thread-safe; a gRPC front-end can wrap this for multi-host the
+way the reference fronts GcsServer with services, without changing callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ..ids import ActorID, NodeID
+from .resources import NodeResources
+
+
+class NodeInfo:
+    __slots__ = ("node_id", "resources", "store_name", "alive",
+                 "last_heartbeat", "labels", "index")
+
+    def __init__(self, node_id: NodeID, resources: NodeResources,
+                 store_name: str, labels: Dict[str, str], index: int):
+        self.node_id = node_id
+        self.resources = resources
+        self.store_name = store_name
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.labels = labels
+        self.index = index
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+class ActorRecord:
+    __slots__ = ("actor_id", "spec", "state", "node_id", "worker_id",
+                 "num_restarts", "death_cause")
+
+    def __init__(self, actor_id: ActorID, spec):
+        self.actor_id = actor_id
+        self.spec = spec
+        self.state = ACTOR_PENDING
+        self.node_id: Optional[NodeID] = None
+        self.worker_id = None
+        self.num_restarts = 0
+        self.death_cause: Optional[str] = None
+
+
+class Pubsub:
+    """Callback-based pub/sub (the long-poll channels of src/ray/pubsub/
+    collapse to direct callbacks in-process)."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class GCS:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.placement_groups: Dict[Any, Any] = {}
+        self.jobs: Dict[Any, dict] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.pubsub = Pubsub()
+        # object directory: object_id bytes -> set of NodeID with a sealed copy
+        self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
+        self._node_index = 0
+
+    # -- nodes ---------------------------------------------------------------
+    def register_node(self, node_id: NodeID, resources: NodeResources,
+                      store_name: str,
+                      labels: Optional[Dict[str, str]] = None) -> NodeInfo:
+        with self._lock:
+            info = NodeInfo(node_id, resources, store_name, labels or {},
+                            self._node_index)
+            self._node_index += 1
+            self.nodes[node_id] = info
+        self.pubsub.publish("node_added", node_id)
+        return info
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info:
+                info.last_heartbeat = time.monotonic()
+
+    def check_heartbeats(self, timeout_s: float) -> List[NodeID]:
+        """Returns nodes newly declared dead (gcs_heartbeat_manager.h:94)."""
+        now = time.monotonic()
+        dead = []
+        with self._lock:
+            for info in self.nodes.values():
+                if info.alive and now - info.last_heartbeat > timeout_s:
+                    info.alive = False
+                    dead.append(info.node_id)
+        for nid in dead:
+            self.pubsub.publish("node_dead", nid)
+        return dead
+
+    def mark_node_dead(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if not info or not info.alive:
+                return
+            info.alive = False
+        self.pubsub.publish("node_dead", node_id)
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # -- actors --------------------------------------------------------------
+    def register_actor(self, record: ActorRecord) -> None:
+        with self._lock:
+            self.actors[record.actor_id] = record
+            name = record.spec.registered_name
+            if name:
+                if name in self.named_actors:
+                    raise ValueError(f"actor name already taken: {name}")
+                self.named_actors[name] = record.actor_id
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str) -> Optional[ActorRecord]:
+        with self._lock:
+            aid = self.named_actors.get(name)
+            return self.actors.get(aid) if aid else None
+
+    def set_actor_state(self, actor_id: ActorID, state: str,
+                        death_cause: Optional[str] = None) -> None:
+        with self._lock:
+            rec = self.actors.get(actor_id)
+            if not rec:
+                return
+            rec.state = state
+            if death_cause:
+                rec.death_cause = death_cause
+            if state == ACTOR_DEAD and rec.spec.registered_name:
+                self.named_actors.pop(rec.spec.registered_name, None)
+        self.pubsub.publish("actor_state", (actor_id, state))
+
+    # -- kv ------------------------------------------------------------------
+    def kv_put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self.kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get(key)
+
+    def kv_del(self, key: str) -> None:
+        with self._lock:
+            self.kv.pop(key, None)
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    # -- object directory ----------------------------------------------------
+    def add_object_location(self, oid: bytes, node_id: NodeID) -> None:
+        with self._lock:
+            self.object_locations[oid].add(node_id)
+
+    def remove_object_location(self, oid: bytes, node_id: NodeID) -> None:
+        with self._lock:
+            locs = self.object_locations.get(oid)
+            if locs:
+                locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
+
+    def get_object_locations(self, oid: bytes) -> Set[NodeID]:
+        with self._lock:
+            return set(self.object_locations.get(oid, ()))
+
+    def drop_node_objects(self, node_id: NodeID) -> List[bytes]:
+        """Remove a dead node from the directory; returns objects that now
+        have zero locations (candidates for lineage reconstruction)."""
+        orphaned = []
+        with self._lock:
+            for oid, locs in list(self.object_locations.items()):
+                locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
+                    orphaned.append(oid)
+        return orphaned

@@ -1,0 +1,258 @@
+"""Node manager: per-node worker pool, dispatch queue, and chip accounting.
+
+The raylet analog (src/ray/raylet/node_manager.h:143) restricted to what a
+single-host TPU node needs:
+  - WorkerPool semantics from worker_pool.h:104,349,427 — prestart, pooled
+    idle workers, dedicated (non-returning) workers for actors;
+  - LocalTaskManager dispatch (local_task_manager.cc:99,256): leased tasks
+    queue here until an idle worker and node resources are available;
+  - TPU chip assignment: the node tracks free chip indices and passes a
+    ``TPU_VISIBLE_CHIPS`` value with each lease — the accelerator-isolation
+    analog of CUDA_VISIBLE_DEVICES assignment (_private/utils.py:349-362).
+
+Runs inside the driver process; worker processes are real OS processes
+spawned via multiprocessing (spawn context, so children never inherit the
+driver's TPU/jax state).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set
+
+from ..config import Config
+from ..ids import NodeID, WorkerID
+from .object_store import NodeObjectStore
+from .resources import NodeResources, Resources, TPU
+from .task_spec import TaskSpec
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "proc", "conn", "node_id", "ready", "idle",
+                 "known_fns", "known_classes", "actor_id", "inflight",
+                 "lease_resources", "visible_chips", "pending_msgs")
+
+    def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
+        self.worker_id = worker_id
+        self.proc = proc  # subprocess.Popen
+        self.conn = None  # set when the worker dials back in
+        self.node_id = node_id
+        self.ready = False
+        self.idle = False
+        self.known_fns: Set[bytes] = set()
+        self.known_classes: Set[bytes] = set()
+        self.actor_id: Optional[bytes] = None  # dedicated actor worker
+        self.inflight: Dict[bytes, TaskSpec] = {}  # task_id -> spec
+        self.lease_resources: Optional[Resources] = None
+        self.visible_chips: Optional[List[int]] = None
+        self.pending_msgs: List[dict] = []  # queued until registration
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class NodeManager:
+    def __init__(
+        self,
+        node_id: NodeID,
+        resources: NodeResources,
+        store_name: str,
+        config: Config,
+        on_worker_started: Callable[[WorkerHandle], None],
+        socket_path: str = "",
+        authkey_hex: str = "",
+    ):
+        self.socket_path = socket_path
+        self.authkey_hex = authkey_hex
+        self.node_id = node_id
+        self.resources = resources
+        self.config = config
+        self.store = NodeObjectStore(store_name, config, create=True)
+        self.store_name = store_name
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: deque = deque()
+        self.queue: deque = deque()  # TaskSpec leased to this node
+        self.starting = 0
+        self.alive = True
+        self._on_worker_started = on_worker_started
+        self._lock = threading.RLock()
+        total_chips = int(resources.total.get(TPU))
+        self.free_chips: List[int] = list(range(total_chips))
+
+    # -- worker pool ----------------------------------------------------------
+    def start_worker(self, dedicated: bool = False) -> WorkerHandle:
+        """Spawn one worker process (WorkerPool::StartWorkerProcess analog,
+        worker_pool.h:427): a fresh interpreter launched with `-m ...worker_main`
+        that dials back into the runtime's Unix socket — the same
+        exec-then-connect handshake the raylet uses with its workers
+        (raylet_client.h:236 registration over the raylet socket)."""
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update({
+            "RMT_WORKER_ID": worker_id.hex(),
+            "RMT_NODE_ID": self.node_id.hex(),
+            "RMT_STORE_NAME": self.store_name,
+            "RMT_SOCKET": self.socket_path,
+            "RMT_AUTHKEY": self.authkey_hex,
+            "RMT_INLINE_LIMIT": str(self.config.max_direct_call_object_size),
+            # workers never see the driver's TPU unless leased chips say so
+            "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS",
+                                     os.environ.get("JAX_PLATFORMS", "cpu")),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "ray_memory_management_tpu.core.worker_main"],
+            env=env, close_fds=True,
+        )
+        handle = WorkerHandle(worker_id, proc, self.node_id)
+        if dedicated:
+            # claimed for an actor before registration: never enters the
+            # idle pool (dedicated workers, worker_pool.h:446)
+            handle.actor_id = b"__pending__"
+        with self._lock:
+            self.workers[worker_id] = handle
+            if not dedicated:
+                self.starting += 1
+        self._on_worker_started(handle)
+        return handle
+
+    def prestart(self, count: Optional[int] = None) -> None:
+        n = self.config.worker_prestart_count if count is None else count
+        for _ in range(n):
+            if len(self.workers) < self.config.max_workers_per_node:
+                self.start_worker()
+
+    def on_worker_ready(self, handle: WorkerHandle) -> None:
+        with self._lock:
+            handle.ready = True
+            self.starting = max(0, self.starting - 1)
+            if handle.actor_id is None:
+                handle.idle = True
+                self.idle_workers.append(handle)
+
+    def remove_worker(self, handle: WorkerHandle) -> None:
+        with self._lock:
+            self.workers.pop(handle.worker_id, None)
+            try:
+                self.idle_workers.remove(handle)
+            except ValueError:
+                pass
+            if not handle.ready:
+                self.starting = max(0, self.starting - 1)
+            if handle.lease_resources is not None:
+                self.resources.free(handle.lease_resources)
+                handle.lease_resources = None
+            if handle.visible_chips:
+                self.free_chips.extend(handle.visible_chips)
+                handle.visible_chips = None
+
+    # -- dispatch -------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self.queue.append(spec)
+
+    def try_dispatch(
+        self, send: Callable[[WorkerHandle, TaskSpec], None]
+    ) -> None:
+        """Match queued tasks to idle workers + resources; start workers on
+        demand (DispatchScheduledTasksToWorkers, local_task_manager.cc:99)."""
+        with self._lock:
+            if not self.alive:
+                return
+            made_progress = True
+            while made_progress and self.queue:
+                made_progress = False
+                spec = self.queue[0]
+                # PG tasks draw from their bundle's reservation, which the
+                # scheduler already deducted from this node's pool
+                req = Resources(
+                    {} if spec.placement is not None else spec.resources
+                )
+                if not req.fits_in(self.resources.available):
+                    break  # head-of-line: wait for running tasks to finish
+                handle = None
+                while self.idle_workers:
+                    cand = self.idle_workers.popleft()
+                    if cand.alive() and cand.ready:
+                        handle = cand
+                        break
+                if handle is None:
+                    can_start = (
+                        len(self.workers) < self.config.max_workers_per_node
+                    )
+                    if can_start and self.starting == 0:
+                        self.start_worker()
+                    break
+                self.queue.popleft()
+                handle.idle = False
+                handle.inflight[spec.task_id] = spec
+                self.resources.allocate(req)
+                handle.lease_resources = req
+                n_chips = int(req.get(TPU))
+                if n_chips > 0:
+                    handle.visible_chips = [
+                        self.free_chips.pop() for _ in range(n_chips)
+                    ]
+                made_progress = True
+                send(handle, spec)
+
+    def finish_task(self, handle: WorkerHandle, task_id: bytes) -> None:
+        """Free the lease and return the worker to the pool."""
+        with self._lock:
+            handle.inflight.pop(task_id, None)
+            if handle.lease_resources is not None:
+                self.resources.free(handle.lease_resources)
+                handle.lease_resources = None
+            if handle.visible_chips:
+                self.free_chips.extend(handle.visible_chips)
+                handle.visible_chips = None
+            if handle.actor_id is None and handle.alive():
+                handle.idle = True
+                self.idle_workers.append(handle)
+
+    def dedicate_to_actor(self, handle: WorkerHandle, actor_id: bytes,
+                          req: Resources, chips: Optional[List[int]]) -> None:
+        """Convert a pooled worker into a dedicated actor worker; the lease
+        lasts for the actor's lifetime (dedicated workers, worker_pool.h:446)."""
+        with self._lock:
+            handle.actor_id = actor_id
+            handle.idle = False
+            try:
+                self.idle_workers.remove(handle)
+            except ValueError:
+                pass
+            self.resources.allocate(req)
+            handle.lease_resources = req
+            handle.visible_chips = chips
+
+    def take_chips(self, n: int) -> Optional[List[int]]:
+        with self._lock:
+            if len(self.free_chips) < n:
+                return None
+            return [self.free_chips.pop() for _ in range(n)]
+
+    def shutdown(self, unlink_store: bool = True) -> None:
+        with self._lock:
+            self.alive = False
+            workers = list(self.workers.values())
+        for h in workers:
+            if h.conn is not None:
+                try:
+                    h.conn.send({"type": "shutdown"})
+                except (OSError, BrokenPipeError):
+                    pass
+        for h in workers:
+            try:
+                h.proc.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                h.proc.terminate()
+            if h.conn is not None:
+                try:
+                    h.conn.close()
+                except OSError:
+                    pass
+        self.store.close(unlink=unlink_store)

@@ -1,0 +1,58 @@
+"""ObjectRef: a first-class future/reference to an immutable object.
+
+The Python-visible half of the reference's ObjectRef (_raylet.pyx ObjectRef):
+value-identity on the 16-byte id, picklable (so refs can be task args —
+borrowing), and hooked into the owner's reference counter on destruction
+(reference_count.h AddLocalReference/RemoveLocalReference analog). Only
+driver-created refs participate in distributed GC in round 1; worker-held
+refs pin via the in-flight-task arg pin instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, object_id: bytes, owner=None):
+        self._id = object_id
+        self._owner = owner
+        if owner is not None:
+            owner.add_local_ref(object_id)
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Refs serialize as bare ids; the receiving side does not register a
+        # local ref (borrowers are pinned by the owner for the duration of the
+        # borrowing task instead — simplified borrowing protocol).
+        return (ObjectRef, (self._id,))
+
+    def __del__(self):
+        owner = self._owner
+        if owner is not None:
+            try:
+                owner.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from .. import _worker_context
+
+        return _worker_context.backend().future_for(self)

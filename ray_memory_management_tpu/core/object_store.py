@@ -1,0 +1,187 @@
+"""Per-node object store: shared-memory tier with spill/restore to disk.
+
+Combines three reference components into the TPU-host store model:
+  - plasma store semantics (create/seal/get/release/delete) come from the
+    native shm store (native/shmstore.cpp — see its header for the mapping);
+  - spilling orchestration mirrors the raylet's LocalObjectManager
+    (src/ray/raylet/local_object_manager.h:99,111,180): when an allocation
+    fails or usage passes ``object_spilling_threshold``, LRU unreferenced
+    objects are written to external storage by IO threads and deleted from
+    shm; a get() of a spilled object restores it transparently;
+  - the owner-side in-process memory store for small objects
+    (src/ray/core_worker/store_provider/memory_store/memory_store.h:43) lives
+    in the driver/worker runtime, not here.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..config import Config
+from ..exceptions import ObjectStoreFullError
+from ..native import ShmStore, ShmStoreFullError
+from . import external_storage as ext
+from ..serialization import SerializedObject
+
+
+class NodeObjectStore:
+    """The store owned by one (virtual) node. Thread-safe."""
+
+    def __init__(self, name: str, config: Optional[Config] = None,
+                 create: bool = True):
+        self.config = config or Config()
+        self.name = name
+        capacity = self.config.object_store_memory
+        self.shm = ShmStore(name, capacity, create=create)
+        self._spill_lock = threading.Lock()
+        self._spilled: Dict[bytes, str] = {}  # object_id -> url
+        self._storage = ext.storage_for_uri(
+            self.config.object_store_fallback_directory
+        )
+        self._io = ThreadPoolExecutor(
+            max_workers=self.config.max_io_workers,
+            thread_name_prefix=f"io-{name.strip('/')}",
+        )
+
+    # -- write path -----------------------------------------------------------
+    def put_serialized(self, object_id: bytes, serialized: SerializedObject) -> None:
+        buf = self._create_with_spill(object_id, serialized.total_size)
+        serialized.write_into(buf)
+        self.shm.seal(object_id)
+
+    def put_bytes(self, object_id: bytes, data) -> None:
+        buf = self._create_with_spill(object_id, len(data))
+        buf[:] = data
+        self.shm.seal(object_id)
+
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        return self._create_with_spill(object_id, size)
+
+    def seal(self, object_id: bytes) -> None:
+        self.shm.seal(object_id)
+
+    def _create_with_spill(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate, spilling LRU objects on pressure — the CreateRequestQueue
+        + spill fallback path (plasma create_request_queue.h:32 +
+        local_object_manager.h:99)."""
+        for _ in range(16):
+            try:
+                return self.shm.create(object_id, size)
+            except ShmStoreFullError:
+                freed = self._spill_for(max(size, self.config.min_spilling_size))
+                if freed == 0:
+                    raise ObjectStoreFullError(
+                        f"store {self.name}: cannot allocate {size} bytes; "
+                        f"usage={self.shm.usage()}, nothing spillable"
+                    )
+        raise ObjectStoreFullError(f"store {self.name}: allocation retry limit")
+
+    def _spill_for(self, need_bytes: int) -> int:
+        """Spill at least ``need_bytes`` of LRU unreferenced objects; returns
+        bytes freed."""
+        with self._spill_lock:
+            candidates = self.shm.evict_candidates(need_bytes)
+            freed = 0
+            futures = []
+            views = {}
+            for oid in candidates:
+                view = self.shm.get(oid, inc_ref=True)
+                if view is None:
+                    continue
+                views[oid] = view
+                futures.append((oid, self._io.submit(
+                    self._storage.spill, oid, view)))
+            for oid, fut in futures:
+                try:
+                    url = fut.result()
+                except Exception:
+                    self.shm.release(oid)
+                    continue
+                self._spilled[oid] = url
+                view = views.pop(oid)
+                nbytes = view.nbytes
+                del view
+                self.shm.release(oid)
+                if self.shm.delete(oid):
+                    freed += nbytes
+                else:
+                    # a reader raced us; keep the spill copy, reclaim later
+                    pass
+            return freed
+
+    # -- read path ------------------------------------------------------------
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy view, restoring from spill if needed. None if absent."""
+        view = self.shm.get(object_id)
+        if view is not None:
+            return view
+        url = self._spilled.get(object_id)
+        if url is None:
+            return None
+        data = self._storage.restore(object_id, url)
+        try:
+            buf = self._create_with_spill(object_id, len(data))
+        except ValueError:
+            # someone restored it concurrently
+            return self.shm.get(object_id)
+        buf[:] = data
+        self.shm.seal(object_id)
+        with self._spill_lock:
+            self._spilled.pop(object_id, None)
+        self._storage.delete(url)
+        return self.shm.get(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return self.shm.contains(object_id) or object_id in self._spilled
+
+    def release(self, object_id: bytes) -> None:
+        self.shm.release(object_id)
+
+    def delete(self, object_id: bytes) -> None:
+        with self._spill_lock:
+            url = self._spilled.pop(object_id, None)
+        if url:
+            self._storage.delete(url)
+        self.shm.delete(object_id)
+
+    def usage(self):
+        return self.shm.usage()
+
+    def spilled_count(self) -> int:
+        return len(self._spilled)
+
+    def close(self, unlink: bool = False) -> None:
+        self._io.shutdown(wait=False)
+        self.shm.close()
+        if unlink:
+            ShmStore.unlink(self.name)
+
+
+class StoreClient:
+    """A read/write client to some node's store from another process on the
+    host (what workers hold; the plasma-client analog)."""
+
+    def __init__(self, name: str):
+        self.shm = ShmStore(name, create=False)
+
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        return self.shm.get(object_id)
+
+    def put_serialized(self, object_id: bytes, serialized: SerializedObject) -> None:
+        try:
+            buf = self.shm.create(object_id, serialized.total_size)
+        except ValueError:
+            return  # already present (e.g. task retry re-producing a return)
+        serialized.write_into(buf)
+        self.shm.seal(object_id)
+
+    def release(self, object_id: bytes) -> None:
+        self.shm.release(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return self.shm.contains(object_id)
+
+    def close(self):
+        self.shm.close()

@@ -1,0 +1,314 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Mirrors the reference's PG stack — public API python/ray/util/placement_group.py:129,
+GCS state machine gcs_placement_group_manager.h:173, bundle policies PACK/
+SPREAD/STRICT_PACK/STRICT_SPREAD (bundle_scheduling_policy.h:82-109), and
+bundle resource commit/return (placement_group_resource_manager.h). Tasks and
+actors scheduled with a PG strategy draw from the bundle's reserved resources
+rather than the node's free pool.
+
+TPU note (net-new vs the reference): bundles requesting TPU chips are placed
+with the same policies, and STRICT_PACK maps naturally to "one ICI domain" —
+the topology-aware extension point the reference lacks (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import PlacementGroupError
+from ..ids import NodeID, ObjectID, PlacementGroupID
+from .resources import Resources
+
+PENDING = "PENDING"
+CREATED = "CREATED"
+REMOVED = "REMOVED"
+
+
+class _Bundle:
+    __slots__ = ("index", "total", "available", "node_id")
+
+    def __init__(self, index: int, total: Resources):
+        self.index = index
+        self.total = total
+        self.available = Resources.from_fixed(total.fixed())
+        self.node_id: Optional[NodeID] = None
+
+
+class PlacementGroup:
+    """User-facing handle (util/placement_group.py PlacementGroup)."""
+
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+
+    def ready(self):
+        """ObjectRef that resolves when all bundles are reserved — used as
+        ``get(pg.ready())`` like the reference."""
+        from .. import _worker_context
+        from .object_ref import ObjectRef
+
+        rt = _worker_context.get_runtime()
+        mgr = _manager(rt)
+        return ObjectRef(mgr.ready_object(self.id), rt)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        from .. import _worker_context
+
+        rt = _worker_context.get_runtime()
+        mgr = _manager(rt)
+        return mgr.wait_created(self.id, timeout_seconds)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup,
+                (self.id, self.bundle_specs, self.strategy, self.name))
+
+
+class _PGState:
+    __slots__ = ("pg", "bundles", "state", "created_event", "ready_oid")
+
+    def __init__(self, pg: PlacementGroup):
+        self.pg = pg
+        self.bundles = [
+            _Bundle(i, Resources(spec)) for i, spec in
+            enumerate(pg.bundle_specs)
+        ]
+        self.state = PENDING
+        self.created_event = threading.Event()
+        self.ready_oid: Optional[bytes] = None
+
+
+class PlacementGroupManager:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self._lock = threading.RLock()
+        self._groups: Dict[bytes, _PGState] = {}
+        self._pending: List[bytes] = []
+        # key (task/actor id) -> (pg_id, bundle_index, Resources)
+        self._allocations: Dict[bytes, Tuple[bytes, int, Resources]] = {}
+
+    # -- creation -------------------------------------------------------------
+    def create(self, bundles: List[Dict[str, float]], strategy: str,
+               name: str = "") -> PlacementGroup:
+        for b in bundles:
+            if not b or all(v == 0 for v in b.values()):
+                raise PlacementGroupError(f"empty bundle in {bundles}")
+        pg_id = PlacementGroupID.from_random().binary()
+        pg = PlacementGroup(pg_id, bundles, strategy, name)
+        state = _PGState(pg)
+        with self._lock:
+            self._groups[pg_id] = state
+            self._pending.append(pg_id)
+        self.runtime.gcs.placement_groups[pg_id] = {
+            "name": name, "strategy": strategy, "bundles": bundles,
+            "state": PENDING,
+        }
+        self.retry_pending()
+        return pg
+
+    def retry_pending(self) -> None:
+        """Try to place all pending groups (two-phase prepare/commit — the
+        GCS PG scheduler loop, gcs_placement_group_scheduler.h)."""
+        with self._lock:
+            pending = list(self._pending)
+        for pg_id in pending:
+            self._try_place(pg_id)
+
+    def _try_place(self, pg_id: bytes) -> None:
+        with self._lock:
+            state = self._groups.get(pg_id)
+            if state is None or state.state != PENDING:
+                return
+            reqs = [b.total for b in state.bundles]
+            placement = self.runtime.scheduler.place_bundles(
+                reqs, state.pg.strategy
+            )
+            if placement is None:
+                return
+            # commit: deduct each bundle from its node's free pool
+            for bundle, node_id in zip(state.bundles, placement):
+                self.runtime.scheduler.allocate(node_id, bundle.total)
+                bundle.node_id = node_id
+            state.state = CREATED
+            self._pending.remove(pg_id)
+            self.runtime.gcs.placement_groups[pg_id]["state"] = CREATED
+            state.created_event.set()
+            if state.ready_oid is not None:
+                self._resolve_ready(state)
+
+    def _resolve_ready(self, state: _PGState) -> None:
+        rt = self.runtime
+        with rt._lock:
+            rt.memory_store[state.ready_oid] = _READY_PAYLOAD
+            fut = rt.futures.get(state.ready_oid)
+            if fut is None:
+                rt.futures[state.ready_oid] = fut = Future()
+        if not fut.done():
+            fut.set_result(True)
+
+    def ready_object(self, pg_id: bytes) -> bytes:
+        from .. import serialization as ser
+
+        global _READY_PAYLOAD
+        _READY_PAYLOAD = ser.dumps(True)
+        rt = self.runtime
+        with self._lock:
+            state = self._groups[pg_id]
+            if state.ready_oid is None:
+                state.ready_oid = ObjectID.for_put().binary()
+                with rt._lock:
+                    rt.futures[state.ready_oid] = Future()
+                if state.state == CREATED:
+                    self._resolve_ready(state)
+        return state.ready_oid
+
+    def wait_created(self, pg_id: bytes, timeout: float) -> bool:
+        with self._lock:
+            state = self._groups.get(pg_id)
+        if state is None:
+            raise PlacementGroupError("unknown placement group")
+        return state.created_event.wait(timeout)
+
+    # -- scheduling integration ----------------------------------------------
+    def acquire(self, pg_id: bytes, bundle_index: int, req: Resources,
+                key: bytes) -> Optional[Tuple[NodeID, int]]:
+        """Reserve ``req`` out of a bundle for ``key`` (a task or actor id);
+        idempotent per key (an actor restart re-resolves without
+        double-counting). Returns (node, bundle_index) or None if the PG is
+        still pending / bundle exhausted."""
+        with self._lock:
+            held = self._allocations.get(key)
+            if held is not None:
+                held_pg, idx, _req = held
+                return self._groups[held_pg].bundles[idx].node_id, idx
+            state = self._groups.get(pg_id)
+            if state is None:
+                raise PlacementGroupError("unknown placement group")
+            if state.state != CREATED:
+                return None
+            candidates = (
+                state.bundles if bundle_index == -1
+                else [state.bundles[bundle_index]]
+            )
+            for bundle in candidates:
+                if req.fits_in(bundle.available):
+                    bundle.available = bundle.available - req
+                    self._allocations[key] = (pg_id, bundle.index, req)
+                    return bundle.node_id, bundle.index
+            return None
+
+    def release_key(self, key: bytes) -> None:
+        with self._lock:
+            held = self._allocations.pop(key, None)
+            if held is None:
+                return
+            pg_id, idx, req = held
+            state = self._groups.get(pg_id)
+            if state is None or state.state == REMOVED:
+                return
+            bundle = state.bundles[idx]
+            bundle.available = bundle.available + req
+
+    def remove(self, pg_id: bytes) -> None:
+        """Return bundle resources to the nodes (bundle return phase)."""
+        with self._lock:
+            state = self._groups.get(pg_id)
+            if state is None or state.state == REMOVED:
+                return
+            if state.state == CREATED:
+                for bundle in state.bundles:
+                    if bundle.node_id is not None:
+                        self.runtime.scheduler.free(bundle.node_id, bundle.total)
+            else:
+                if pg_id in self._pending:
+                    self._pending.remove(pg_id)
+            state.state = REMOVED
+            self.runtime.gcs.placement_groups[pg_id]["state"] = REMOVED
+
+    def table(self) -> Dict[bytes, dict]:
+        return dict(self.runtime.gcs.placement_groups)
+
+
+_READY_PAYLOAD = b""
+
+
+def _manager(runtime) -> PlacementGroupManager:
+    if runtime.pg_manager is None:
+        runtime.pg_manager = PlacementGroupManager(runtime)
+    return runtime.pg_manager
+
+
+# -- runtime hooks -----------------------------------------------------------
+def resolve_pg_node(runtime, spec) -> Optional[NodeID]:
+    """Resolve a task's PG strategy to a node, drawing from the bundle.
+    Called by Runtime._schedule; returns None to park the task until the PG
+    is created or the bundle frees up."""
+    strategy = spec.strategy
+    if isinstance(strategy, object) and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        pg_id = pg.id if isinstance(pg, PlacementGroup) else pg
+        bundle_index = strategy.placement_group_bundle_index
+    else:
+        pg_id, bundle_index = spec.placement[:2]
+    mgr = _manager(runtime)
+    req = Resources(spec.resources)
+    got = mgr.acquire(pg_id, bundle_index, req, key=spec.task_id)
+    if got is None:
+        return None
+    node_id, idx = got
+    # the bundle already reserved node resources; node dispatch must not
+    # double-count them (placement set => zero node-level request)
+    spec.placement = (pg_id, idx)
+    return node_id
+
+
+def resolve_pg_node_for_actor(runtime, spec) -> Optional[NodeID]:
+    pg_id, bundle_index = spec.placement[:2]
+    mgr = _manager(runtime)
+    req = Resources(spec.resources)
+    deadline = time.monotonic() + runtime.config.worker_lease_timeout_s
+    while time.monotonic() < deadline:
+        got = mgr.acquire(pg_id, bundle_index, req, key=spec.actor_id)
+        if got is not None:
+            node_id, idx = got
+            spec.placement = (pg_id, idx)
+            return node_id
+        time.sleep(0.02)
+    return None
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    """Create a placement group (util/placement_group.py:129)."""
+    from .. import _worker_context
+
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        raise PlacementGroupError("placement groups are driver-side only")
+    return _manager(rt).create(bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from .. import _worker_context
+
+    rt = _worker_context.get_runtime()
+    _manager(rt).remove(pg.id if isinstance(pg, PlacementGroup) else pg)
+
+
+def placement_group_table() -> Dict[str, dict]:
+    from .. import _worker_context
+
+    rt = _worker_context.get_runtime()
+    if rt is None or rt.pg_manager is None:
+        return {}
+    return {k.hex(): v for k, v in rt.pg_manager.table().items()}

@@ -1,0 +1,136 @@
+"""Fixed-point resource accounting.
+
+Mirrors the reference's raylet resource math (src/ray/raylet/scheduling/fixed_point.h
+and cluster_resource_data.h:416 NodeResources): resource quantities are stored
+as integers in units of 1/10000 so that fractional resources (e.g. num_cpus=0.5)
+never drift under repeated add/subtract.
+
+Resource names follow the reference's convention: "CPU", "memory",
+"object_store_memory", custom strings — plus "TPU", the first-class accelerator
+resource this framework adds (the analog of "GPU" in _private/resource_spec.py:88-101).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+PRECISION = 10_000
+
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+
+def _to_fixed(v: float) -> int:
+    return round(v * PRECISION)
+
+
+class Resources:
+    """An immutable-ish bag of named fixed-point resource quantities."""
+
+    __slots__ = ("_amounts",)
+
+    def __init__(self, amounts: Optional[Dict[str, float]] = None, _fixed=None):
+        if _fixed is not None:
+            self._amounts: Dict[str, int] = _fixed
+        else:
+            self._amounts = {
+                k: _to_fixed(v) for k, v in (amounts or {}).items() if v
+            }
+
+    @classmethod
+    def from_fixed(cls, fixed: Dict[str, int]) -> "Resources":
+        return cls(_fixed=dict(fixed))
+
+    def get(self, name: str) -> float:
+        return self._amounts.get(name, 0) / PRECISION
+
+    def fixed(self) -> Dict[str, int]:
+        return dict(self._amounts)
+
+    def is_empty(self) -> bool:
+        return not any(self._amounts.values())
+
+    def names(self) -> Iterable[str]:
+        return self._amounts.keys()
+
+    def fits_in(self, other: "Resources") -> bool:
+        return all(
+            amt <= other._amounts.get(name, 0)
+            for name, amt in self._amounts.items()
+        )
+
+    def __add__(self, other: "Resources") -> "Resources":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) + v
+        return Resources.from_fixed(out)
+
+    def __sub__(self, other: "Resources") -> "Resources":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) - v
+        return Resources.from_fixed(out)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: v / PRECISION for k, v in self._amounts.items() if v}
+
+    def __repr__(self):
+        return f"Resources({self.to_dict()})"
+
+    def __eq__(self, other):
+        return isinstance(other, Resources) and other._amounts == self._amounts
+
+
+def task_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    default_cpus: float = 1.0,
+) -> Resources:
+    """Build a task/actor resource request with the reference's defaults:
+    tasks default to 1 CPU; actors default to 0 (remote_function.py /
+    actor.py option handling)."""
+    out: Dict[str, float] = dict(resources or {})
+    out[CPU] = default_cpus if num_cpus is None else num_cpus
+    if num_tpus:
+        out[TPU] = num_tpus
+    if memory:
+        out[MEMORY] = memory
+    return Resources(out)
+
+
+class NodeResources:
+    """Total + available resources of one node (cluster_resource_data.h:416)."""
+
+    __slots__ = ("total", "available", "labels")
+
+    def __init__(self, total: Resources, labels: Optional[Dict[str, str]] = None):
+        self.total = total
+        self.available = Resources.from_fixed(total.fixed())
+        self.labels = labels or {}
+
+    def can_fit(self, req: Resources) -> bool:
+        return req.fits_in(self.available)
+
+    def is_feasible(self, req: Resources) -> bool:
+        return req.fits_in(self.total)
+
+    def allocate(self, req: Resources) -> None:
+        self.available = self.available - req
+
+    def free(self, req: Resources) -> None:
+        self.available = self.available + req
+
+    def utilization(self) -> float:
+        """Max utilization over resource kinds present on the node (the
+        hybrid policy's node-ranking signal, hybrid_scheduling_policy.h:48)."""
+        util = 0.0
+        for name, tot in self.total.fixed().items():
+            if tot <= 0:
+                continue
+            avail = self.available.fixed().get(name, 0)
+            util = max(util, 1.0 - avail / tot)
+        return util

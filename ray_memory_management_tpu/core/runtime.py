@@ -1,0 +1,1276 @@
+"""Driver-side runtime: ownership, submission, routing, fault tolerance.
+
+This is the CoreWorker-of-the-driver (src/ray/core_worker/core_worker.h:63)
+fused with the pieces of the raylet the single-host model centralizes:
+
+  - TaskManager: owner-side task state, retries, lineage for reconstruction
+    (task_manager.h:86,135);
+  - ReferenceCounter (simplified): local python refs pin objects; task args
+    are pinned for the task's duration (reference_count.h:61);
+  - ObjectRecoveryManager: a lost object with recorded lineage re-submits its
+    producing task (object_recovery_manager.h:41);
+  - scheduling: dependency resolution then node selection then node-local
+    dispatch (direct_task_transport.cc:22 + cluster_task_manager.cc:44);
+  - the router thread plays the role of the per-worker gRPC reply streams:
+    one thread multiplexes all worker pipes (multiprocessing.connection.wait),
+    handling replies inline and farming potentially-blocking worker requests
+    (nested get/wait) to a service pool.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing import connection as mpc
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .. import _worker_context
+from .. import serialization as ser
+from ..config import Config
+from ..exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ..ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from .gcs import (
+    ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING, ActorRecord, GCS,
+)
+from .node_manager import NodeManager, WorkerHandle
+from .object_ref import ObjectRef
+from .object_store import StoreClient
+from .resources import NodeResources, Resources, TPU, task_resources
+from .scheduler import ClusterScheduler
+from .scheduling_strategies import PlacementGroupSchedulingStrategy
+from .task_spec import ActorCreationSpec, TaskSpec
+
+
+class _TaskRecord:
+    __slots__ = ("spec", "retries_left", "state", "payload")
+
+    def __init__(self, spec: TaskSpec, payload: dict, retries_left: int):
+        self.spec = spec
+        self.payload = payload  # original submission payload, for resubmit
+        self.retries_left = retries_left
+        self.state = "PENDING"
+
+
+class _ActorInfo:
+    __slots__ = ("spec", "record", "node_id", "handle", "seq", "pending",
+                 "creation_future", "handle_count")
+
+    def __init__(self, spec: ActorCreationSpec, record: ActorRecord):
+        self.spec = spec
+        self.record = record
+        self.node_id: Optional[NodeID] = None
+        self.handle: Optional[WorkerHandle] = None
+        self.seq = itertools.count()
+        self.pending: deque = deque()  # TaskSpecs waiting for ALIVE
+        self.creation_future: Future = Future()
+        self.handle_count = 0
+
+
+class Runtime:
+    def __init__(self, config: Config, nodes_spec: List[dict],
+                 namespace: Optional[str] = None):
+        self.config = config
+        self.job_id = JobID.from_random()
+        self.namespace = namespace or f"rmt_{os.getpid()}_{id(self) & 0xffff}"
+        self.gcs = GCS()
+        self.scheduler = ClusterScheduler(self.gcs, config)
+        self.nodes: Dict[NodeID, NodeManager] = {}
+        self._store_clients: Dict[NodeID, StoreClient] = {}
+        self._head_node_id: Optional[NodeID] = None
+
+        # owner state
+        self.memory_store: Dict[bytes, bytes] = {}  # small objects (serialized)
+        self.futures: Dict[bytes, Future] = {}
+        self.tasks: Dict[bytes, _TaskRecord] = {}
+        self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
+        self.local_refs: Dict[bytes, int] = defaultdict(int)
+        self.actors: Dict[bytes, _ActorInfo] = {}
+        self.fn_blobs: Dict[bytes, bytes] = {}
+        self.cls_blobs: Dict[bytes, bytes] = {}
+        self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids
+        self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)
+        self._pending_schedule: deque = deque()
+        self._cancelled: Set[bytes] = set()
+
+        self._lock = threading.RLock()
+        self._conn_handles: Dict[Any, WorkerHandle] = {}
+        self._request_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="rmt-serve"
+        )
+        self._transfer_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rmt-xfer"
+        )
+        self._conn_send_locks: Dict[Any, threading.Lock] = {}
+        self._wakeup_r, self._wakeup_w = os.pipe()
+        self._stop = threading.Event()
+        self.pg_manager = None  # set by placement_group module on first use
+
+        # worker registration socket (workers dial back in after exec)
+        self._authkey = os.urandom(16)
+        self._socket_path = f"/tmp/{self.namespace}.sock"
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener(
+            self._socket_path, family="AF_UNIX", authkey=self._authkey
+        )
+        self._workers_by_id: Dict[bytes, WorkerHandle] = {}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rmt-accept"
+        )
+        self._accept_thread.start()
+
+        for i, spec in enumerate(nodes_spec):
+            self.add_node(spec, head=(i == 0))
+
+        self._router = threading.Thread(
+            target=self._router_loop, daemon=True, name="rmt-router"
+        )
+        self._router.start()
+        self._hb = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="rmt-heartbeat"
+        )
+        self._hb.start()
+        for nm in self.nodes.values():
+            nm.prestart()
+        # best-effort cleanup if the driver exits without shutdown(): shm
+        # stores are kernel objects and would otherwise outlive the process
+        import atexit
+
+        atexit.register(self._atexit_shutdown)
+
+    # ------------------------------------------------------------------ nodes
+    def add_node(self, spec: dict, head: bool = False) -> NodeID:
+        node_id = NodeID.from_random()
+        res = task_resources(
+            num_cpus=spec.get("num_cpus", 4),
+            num_tpus=spec.get("num_tpus", 0),
+            resources=spec.get("resources"),
+            default_cpus=spec.get("num_cpus", 4),
+        )
+        node_res = NodeResources(res)
+        store_name = f"/{self.namespace}_{node_id.hex()[:8]}"
+        nm = NodeManager(
+            node_id, node_res, store_name, self.config,
+            on_worker_started=self._register_worker,
+            socket_path=self._socket_path,
+            authkey_hex=self._authkey.hex(),
+        )
+        with self._lock:
+            self.nodes[node_id] = nm
+            self.gcs.register_node(node_id, node_res, store_name,
+                                   spec.get("labels"))
+            if head or self._head_node_id is None:
+                self._head_node_id = node_id
+        self._wakeup()
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node failure (Cluster.remove_node, cluster_utils.py:238):
+        workers die, store contents are lost, GCS broadcasts node death."""
+        with self._lock:
+            nm = self.nodes.get(node_id)
+            if nm is None:
+                return
+            nm.alive = False
+            self.gcs.mark_node_dead(node_id)
+            requeue = list(nm.queue)
+            nm.queue.clear()
+            workers = list(nm.workers.values())
+        for h in workers:
+            try:
+                h.proc.terminate()
+            except Exception:
+                pass
+        # router will observe EOFs; handle queued (not yet dispatched) tasks
+        for spec in requeue:
+            self._schedule(spec)
+        self.gcs.drop_node_objects(node_id)
+        self._wakeup()
+
+    def head_node(self) -> NodeManager:
+        return self.nodes[self._head_node_id]
+
+    def _store_client_for(self, node_id: NodeID) -> StoreClient:
+        # Same-host: the driver can map any node's store directly. Multi-host
+        # would pull over the DCN object plane instead (object_manager.proto).
+        with self._lock:
+            cli = self._store_clients.get(node_id)
+            if cli is None:
+                nm = self.nodes[node_id]
+                if nm is self.head_node():
+                    # reuse the node's own mapping
+                    cli = nm.store
+                else:
+                    cli = StoreClient(nm.store_name)
+                self._store_clients[node_id] = cli
+        return cli
+
+    # ---------------------------------------------------------------- workers
+    def _register_worker(self, handle: WorkerHandle) -> None:
+        with self._lock:
+            self._workers_by_id[handle.worker_id.binary()] = handle
+
+    def _accept_loop(self) -> None:
+        """Bind dialing-in worker processes to their handles (the raylet's
+        RegisterClient handshake)."""
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if msg.get("type") != "ready":
+                conn.close()
+                continue
+            with self._lock:
+                handle = self._workers_by_id.get(msg["worker_id"])
+                if handle is None:
+                    conn.close()
+                    continue
+                handle.conn = conn
+                self._conn_handles[conn] = handle
+                self._conn_send_locks[conn] = threading.Lock()
+                pending = list(handle.pending_msgs)
+                handle.pending_msgs.clear()
+            nm = self.nodes.get(handle.node_id)
+            if nm:
+                nm.on_worker_ready(handle)
+            for m in pending:
+                self._send(handle, m)
+            self._wakeup()
+            self._pump()
+
+    def _send(self, handle: WorkerHandle, msg: dict) -> bool:
+        with self._lock:
+            if handle.conn is None:
+                if handle.alive():
+                    handle.pending_msgs.append(msg)
+                    return True
+                return False
+            lock = self._conn_send_locks.get(handle.conn)
+        if lock is None:
+            return False
+        try:
+            with lock:
+                handle.conn.send(msg)
+            return True
+        except (OSError, BrokenPipeError, ValueError):
+            return False
+
+    def _wakeup(self) -> None:
+        try:
+            os.write(self._wakeup_w, b"x")
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------------- router
+    def _router_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                conns = list(self._conn_handles.keys())
+            try:
+                ready = mpc.wait(conns + [self._wakeup_r], timeout=0.25)
+            except OSError:
+                time.sleep(0.01)
+                continue
+            for r in ready:
+                if r == self._wakeup_r:
+                    try:
+                        os.read(self._wakeup_r, 4096)
+                    except OSError:
+                        pass
+                    continue
+                handle = self._conn_handles.get(r)
+                if handle is None:
+                    continue
+                try:
+                    msg = r.recv()
+                except (EOFError, OSError):
+                    self._on_worker_death(handle)
+                    continue
+                self._handle_worker_message(handle, msg)
+            self._pump()
+
+    def _handle_worker_message(self, handle: WorkerHandle, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == "done":
+            self._on_task_done(handle, msg)
+        elif mtype == "actor_created":
+            self._on_actor_created(handle, msg)
+        elif mtype == "pong":
+            pass
+        else:
+            # nested-call requests from user code in the worker; may block on
+            # futures, so never service them on the router thread
+            self._request_pool.submit(self._serve_worker_request, handle, msg)
+
+    # ------------------------------------------------------- task submission
+    def submit_task(self, payload: dict) -> List[bytes]:
+        task_id = TaskID.for_task(self.job_id)
+        num_returns = payload.get("num_returns", 1)
+        return_ids = [
+            ObjectID.for_return(task_id, i).binary() for i in range(num_returns)
+        ]
+        if payload.get("fn_blob") is not None:
+            self.fn_blobs.setdefault(payload["fn_id"], payload["fn_blob"])
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            name=payload.get("name", "task"),
+            fn_id=payload["fn_id"],
+            args=payload["args"],
+            kwargs=payload.get("kwargs", {}),
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=payload.get("resources", {"CPU": 1.0}),
+            strategy=payload.get("strategy"),
+            max_retries=payload.get(
+                "max_retries", self.config.task_max_retries
+            ),
+            retry_exceptions=payload.get("retry_exceptions", False),
+        )
+        rec = _TaskRecord(spec, payload, spec.max_retries)
+        with self._lock:
+            self.tasks[spec.task_id] = rec
+            for oid in return_ids:
+                self.futures[oid] = Future()
+                self.lineage[oid] = spec.task_id
+        self._resolve_deps_then_schedule(spec)
+        return return_ids
+
+    def _ref_deps(self, spec: TaskSpec) -> List[bytes]:
+        deps = []
+        for kind, payload in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "ref":
+                deps.append(payload)
+        return deps
+
+    def _resolve_deps_then_schedule(self, spec: TaskSpec) -> None:
+        """LocalDependencyResolver analog (dependency_resolver.h:29): wait for
+        in-flight args to materialize before asking for a worker lease."""
+        missing: Set[bytes] = set()
+        with self._lock:
+            for oid in self._ref_deps(spec):
+                fut = self.futures.get(oid)
+                if fut is not None and not fut.done():
+                    missing.add(oid)
+            if missing:
+                self._waiting_deps[spec.task_id] = missing
+                for oid in missing:
+                    self._dep_waiters[oid].append(spec.task_id)
+                return
+        self._schedule(spec)
+
+    def _on_dep_ready(self, oid: bytes) -> None:
+        ready_specs = []
+        with self._lock:
+            for task_id in self._dep_waiters.pop(oid, ()):  # noqa: B020
+                missing = self._waiting_deps.get(task_id)
+                if missing is None:
+                    continue
+                missing.discard(oid)
+                if not missing:
+                    del self._waiting_deps[task_id]
+                    rec = self.tasks.get(task_id)
+                    if rec:
+                        ready_specs.append(rec.spec)
+        for spec in ready_specs:
+            self._schedule(spec)
+
+    def _release_pg_allocation(self, spec: TaskSpec) -> None:
+        if spec.placement is not None and self.pg_manager is not None:
+            self.pg_manager.release_key(spec.task_id)
+
+    def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
+        self._release_pg_allocation(spec)
+        with self._lock:
+            for oid in spec.return_ids:
+                fut = self.futures.get(oid)
+                if fut and not fut.done():
+                    fut.set_exception(exc)
+            rec = self.tasks.get(spec.task_id)
+            if rec:
+                rec.state = "FAILED"
+
+    def _schedule(self, spec: TaskSpec) -> None:
+        if spec.task_id in self._cancelled:
+            self._fail_task(spec, TaskError(spec.name, None, "cancelled"))
+            return
+        strategy = spec.strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy) or (
+            spec.placement is not None
+        ):
+            from .placement_group import resolve_pg_node
+
+            node_id = resolve_pg_node(self, spec)
+            if node_id is None:
+                with self._lock:
+                    self._pending_schedule.append(spec)
+                return
+        else:
+            try:
+                node_id = self.scheduler.pick_node(
+                    Resources(spec.resources), strategy
+                )
+            except ValueError as e:
+                self._fail_task(spec, TaskError(spec.name, None, str(e)))
+                return
+            if node_id is None:
+                with self._lock:
+                    self._pending_schedule.append(spec)
+                return
+        self._place_on_node(spec, node_id)
+
+    def _place_on_node(self, spec: TaskSpec, node_id: NodeID) -> None:
+        nm = self.nodes[node_id]
+        if not self._ensure_args_local(spec, node_id):
+            return  # transfer in flight; re-placed when it completes
+        nm.submit(spec)
+        with self._lock:
+            rec = self.tasks.get(spec.task_id)
+            if rec:
+                rec.state = "SCHEDULED"
+        self._pump_node(nm)
+
+    def _ensure_args_local(self, spec: TaskSpec, node_id: NodeID) -> bool:
+        """Make every ref arg readable on ``node_id``'s store. Inline args in
+        the driver memory store don't need transfer (they ship in the exec
+        message). Cross-node copies run on the transfer pool — the chunked
+        push/pull object plane (object_manager.h:114) collapsed to a same-host
+        memcpy."""
+        to_fetch: List[Tuple[bytes, NodeID]] = []
+        with self._lock:
+            for oid in self._ref_deps(spec):
+                if oid in self.memory_store:
+                    continue
+                target_store = self.nodes[node_id].store
+                if target_store.contains(oid):
+                    continue
+                locs = self.gcs.get_object_locations(oid)
+                locs = [l for l in locs if l != node_id and
+                        self.nodes.get(l) and self.nodes[l].alive]
+                if not locs:
+                    # lost object: trigger recovery, then retry scheduling
+                    self._transfer_pool.submit(
+                        self._recover_then_reschedule, oid, spec, node_id
+                    )
+                    return False
+                to_fetch.append((oid, locs[0]))
+        if not to_fetch:
+            return True
+
+        def do_transfers():
+            try:
+                for oid, src in to_fetch:
+                    self._transfer_object(oid, src, node_id)
+                self.nodes[node_id].submit(spec)
+                self._wakeup()
+            except Exception as e:  # transfer failed: fail the task
+                self._fail_task(spec, TaskError(spec.name, e))
+
+        self._transfer_pool.submit(do_transfers)
+        return False
+
+    def _transfer_object(self, oid: bytes, src: NodeID, dst: NodeID) -> None:
+        src_cli = self._store_client_for(src)
+        view = src_cli.get(oid)
+        if view is None:
+            raise ObjectLostError(oid.hex(), f"vanished from {src}")
+        try:
+            dst_store = self.nodes[dst].store
+            chunk = self.config.object_manager_chunk_size
+            try:
+                buf = dst_store.create(oid, view.nbytes)
+            except ValueError:
+                return  # already there
+            for off in range(0, view.nbytes, chunk):
+                end = min(off + chunk, view.nbytes)
+                buf[off:end] = view[off:end]
+            dst_store.seal(oid)
+            self.gcs.add_object_location(oid, dst)
+        finally:
+            src_cli.release(oid)
+
+    def _recover_then_reschedule(self, oid: bytes, spec: TaskSpec,
+                                 node_id: NodeID) -> None:
+        try:
+            self._recover_object(oid)
+            self._place_on_node(spec, node_id)
+        except Exception as e:
+            self._fail_task(spec, TaskError(spec.name, e))
+
+    # ------------------------------------------------------------- dispatch
+    def _pump(self) -> None:
+        if self.pg_manager is not None:
+            self.pg_manager.retry_pending()
+        with self._lock:
+            pending = list(self._pending_schedule)
+            self._pending_schedule.clear()
+        for spec in pending:
+            self._schedule(spec)
+        for nm in list(self.nodes.values()):
+            self._pump_node(nm)
+
+    def _pump_node(self, nm: NodeManager) -> None:
+        nm.try_dispatch(self._send_task)
+
+    def _send_task(self, handle: WorkerHandle, spec: TaskSpec) -> None:
+        msg = self._task_msg(handle, spec)
+        if not self._send(handle, msg):
+            self._on_worker_death(handle)
+
+    def _task_msg(self, handle: WorkerHandle, spec: TaskSpec) -> dict:
+        args = [self._finalize_arg(a) for a in spec.args]
+        kwargs = {k: self._finalize_arg(v) for k, v in spec.kwargs.items()}
+        if spec.is_actor_task:
+            msg = {
+                "type": "exec_actor", "task_id": spec.task_id,
+                "actor_id": spec.actor_id, "method": spec.method,
+                "name": spec.name, "args": args, "kwargs": kwargs,
+                "return_ids": spec.return_ids, "seq": spec.seq,
+            }
+        else:
+            msg = {
+                "type": "exec", "task_id": spec.task_id, "fn_id": spec.fn_id,
+                "name": spec.name, "args": args, "kwargs": kwargs,
+                "return_ids": spec.return_ids,
+            }
+            if spec.fn_id not in handle.known_fns:
+                msg["fn_blob"] = self.fn_blobs[spec.fn_id]
+                handle.known_fns.add(spec.fn_id)
+            if handle.visible_chips is not None:
+                msg["visible_chips"] = ",".join(
+                    str(c) for c in handle.visible_chips
+                )
+        return msg
+
+    def _finalize_arg(self, arg):
+        kind, payload = arg
+        if kind == "ref":
+            data = self.memory_store.get(payload)
+            if data is not None:
+                return ("v", data)
+        return arg
+
+    # ------------------------------------------------------------ completion
+    def _on_task_done(self, handle: WorkerHandle, msg: dict) -> None:
+        task_id = msg["task_id"]
+        nm = self.nodes.get(handle.node_id)
+        spec = handle.inflight.get(task_id)
+        if nm:
+            nm.finish_task(handle, task_id)
+        if spec is not None:
+            self._release_pg_allocation(spec)
+        with self._lock:
+            rec = self.tasks.get(task_id)
+        if msg["error"] is not None:
+            exc = ser.loads(msg["error"])
+            if rec and spec and rec.retries_left > 0 and spec.retry_exceptions:
+                rec.retries_left -= 1
+                self._resolve_deps_then_schedule(spec)
+                return
+            if rec and spec:
+                self._fail_task(spec, exc)
+            return
+        ready_oids = []
+        with self._lock:
+            for oid, kind, data in msg["returns"]:
+                if kind == "v":
+                    self.memory_store[oid] = data
+                else:
+                    self.gcs.add_object_location(oid, handle.node_id)
+                fut = self.futures.get(oid)
+                if fut is None:
+                    self.futures[oid] = fut = Future()
+                if not fut.done():
+                    fut.set_result(True)
+                ready_oids.append(oid)
+            if rec:
+                rec.state = "FINISHED"
+        for oid in ready_oids:
+            self._on_dep_ready(oid)
+
+    # --------------------------------------------------------------- actors
+    def create_actor(self, payload: dict) -> bytes:
+        actor_id = ActorID.from_random()
+        if payload.get("cls_blob") is not None:
+            self.cls_blobs.setdefault(payload["cls_id"], payload["cls_blob"])
+        spec = ActorCreationSpec(
+            actor_id=actor_id.binary(),
+            name=payload.get("name", "Actor"),
+            cls_id=payload["cls_id"],
+            args=payload["args"],
+            kwargs=payload.get("kwargs", {}),
+            resources=payload.get("resources", {}),
+            strategy=payload.get("strategy"),
+            max_restarts=payload.get("max_restarts", 0),
+            max_task_retries=payload.get("max_task_retries", 0),
+            max_concurrency=payload.get("max_concurrency", 1),
+            placement=payload.get("placement"),
+            detached=payload.get("detached", False),
+            registered_name=payload.get("registered_name"),
+        )
+        record = ActorRecord(actor_id, spec)
+        self.gcs.register_actor(record)
+        info = _ActorInfo(spec, record)
+        with self._lock:
+            self.actors[spec.actor_id] = info
+        self._request_pool.submit(self._start_actor, info)
+        return spec.actor_id
+
+    def _start_actor(self, info: _ActorInfo) -> None:
+        spec = info.spec
+        req = Resources(spec.resources)
+        try:
+            if spec.placement is not None:
+                from .placement_group import resolve_pg_node_for_actor
+
+                node_id = resolve_pg_node_for_actor(self, spec)
+            else:
+                node_id = None
+                deadline = time.monotonic() + self.config.worker_lease_timeout_s
+                while node_id is None and time.monotonic() < deadline:
+                    node_id = self.scheduler.pick_node(req, spec.strategy)
+                    if node_id is None:
+                        time.sleep(0.02)
+            if node_id is None:
+                raise TimeoutError(
+                    f"no resources to place actor {spec.name}"
+                )
+        except Exception as e:
+            self.gcs.set_actor_state(info.record.actor_id, ACTOR_DEAD, str(e))
+            info.creation_future.set_exception(ActorDiedError(str(e)))
+            self._fail_actor_queue(info, ActorDiedError(str(e)))
+            return
+        nm = self.nodes[node_id]
+        info.node_id = node_id
+        chips = None
+        n_chips = int(req.get(TPU))
+        if n_chips:
+            chips = nm.take_chips(n_chips)
+        # PG actors: the bundle reservation already deducted node resources
+        lease = Resources({}) if spec.placement is not None else req
+        handle = nm.start_worker(dedicated=True)
+        nm.dedicate_to_actor(handle, spec.actor_id, lease, chips)
+        info.handle = handle
+        info.record.node_id = node_id
+        info.record.worker_id = handle.worker_id
+        msg = {
+            "type": "create_actor", "actor_id": spec.actor_id,
+            "cls_id": spec.cls_id, "name": spec.name,
+            "args": [self._finalize_arg(a) for a in spec.args],
+            "kwargs": {k: self._finalize_arg(v)
+                       for k, v in spec.kwargs.items()},
+            "max_concurrency": spec.max_concurrency,
+        }
+        if spec.cls_id not in handle.known_classes:
+            msg["cls_blob"] = self.cls_blobs[spec.cls_id]
+            handle.known_classes.add(spec.cls_id)
+        if chips is not None:
+            msg["visible_chips"] = ",".join(str(c) for c in chips)
+        if not self._send(handle, msg):
+            self._on_worker_death(handle)
+
+    def _on_actor_created(self, handle: WorkerHandle, msg: dict) -> None:
+        actor_id = msg["actor_id"]
+        with self._lock:
+            info = self.actors.get(actor_id)
+        if info is None:
+            return
+        if msg["error"] is not None:
+            exc = ser.loads(msg["error"])
+            self.gcs.set_actor_state(
+                info.record.actor_id, ACTOR_DEAD, str(exc)
+            )
+            if not info.creation_future.done():
+                info.creation_future.set_exception(exc)
+            self._fail_actor_queue(info, exc)
+            return
+        self.gcs.set_actor_state(info.record.actor_id, ACTOR_ALIVE)
+        if not info.creation_future.done():
+            info.creation_future.set_result(True)
+        flush = []
+        with self._lock:
+            while info.pending:
+                flush.append(info.pending.popleft())
+        for spec in flush:
+            self._dispatch_actor_task(info, spec)
+
+    def submit_actor_task(self, payload: dict) -> List[bytes]:
+        actor_id = payload["actor_id"]
+        with self._lock:
+            info = self.actors.get(actor_id)
+        if info is None:
+            raise ActorDiedError("unknown actor")
+        task_id = TaskID.for_task(self.job_id)
+        num_returns = payload.get("num_returns", 1)
+        return_ids = [
+            ObjectID.for_return(task_id, i).binary() for i in range(num_returns)
+        ]
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            name=f"{info.spec.name}.{payload['method']}",
+            fn_id=b"",
+            args=payload["args"],
+            kwargs=payload.get("kwargs", {}),
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            actor_id=actor_id,
+            method=payload["method"],
+            seq=next(info.seq),
+            max_retries=info.spec.max_task_retries,
+        )
+        rec = _TaskRecord(spec, payload, info.spec.max_task_retries)
+        with self._lock:
+            self.tasks[spec.task_id] = rec
+            for oid in return_ids:
+                self.futures[oid] = Future()
+        state = info.record.state
+        if state == ACTOR_DEAD:
+            self._fail_task(spec, ActorDiedError(
+                info.record.death_cause or "actor is dead"))
+        elif state == ACTOR_ALIVE:
+            self._dispatch_actor_task(info, spec)
+        else:  # pending / restarting: queue in seq order
+            with self._lock:
+                info.pending.append(spec)
+        return return_ids
+
+    def _dispatch_actor_task(self, info: _ActorInfo, spec: TaskSpec) -> None:
+        # Dependencies: actor tasks with pending-object args wait like normal
+        # tasks, but must preserve seq order; the pipe preserves send order, so
+        # we only defer if a dep is truly unready.
+        missing = []
+        with self._lock:
+            for oid in self._ref_deps(spec):
+                fut = self.futures.get(oid)
+                if fut is not None and not fut.done():
+                    missing.append(oid)
+        if missing:
+            def wait_then_send():
+                for oid in missing:
+                    f = self.futures.get(oid)
+                    if f is not None:
+                        try:
+                            f.result(timeout=3600)
+                        except Exception:
+                            pass
+                self._ensure_actor_args_then_send(info, spec)
+            self._request_pool.submit(wait_then_send)
+            return
+        self._ensure_actor_args_then_send(info, spec)
+
+    def _ensure_actor_args_then_send(self, info: _ActorInfo,
+                                     spec: TaskSpec) -> None:
+        handle = info.handle
+        if handle is None or not handle.alive():
+            with self._lock:
+                info.pending.append(spec)
+            return
+        node_id = info.node_id
+        # transfer any store-resident args to the actor's node
+        for oid in self._ref_deps(spec):
+            with self._lock:
+                in_mem = oid in self.memory_store
+            if in_mem:
+                continue
+            if self.nodes[node_id].store.contains(oid):
+                continue
+            locs = [l for l in self.gcs.get_object_locations(oid)
+                    if l != node_id and self.nodes.get(l)
+                    and self.nodes[l].alive]
+            if locs:
+                self._transfer_object(oid, locs[0], node_id)
+            else:
+                try:
+                    self._recover_object(oid)
+                except Exception as e:
+                    self._fail_task(spec, TaskError(spec.name, e))
+                    return
+        handle.inflight[spec.task_id] = spec
+        if not self._send(handle, self._task_msg(handle, spec)):
+            self._on_worker_death(handle)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+        if info is None:
+            return
+        if no_restart:
+            info.spec.max_restarts = 0
+        self.gcs.set_actor_state(
+            info.record.actor_id, ACTOR_DEAD, "killed via kill()"
+        )
+        self._release_actor_pg(info)
+        handle = info.handle
+        if handle is not None:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+        self._fail_actor_queue(info, ActorDiedError("actor killed"))
+
+    def _fail_actor_queue(self, info: _ActorInfo, exc: Exception) -> None:
+        with self._lock:
+            pending = list(info.pending)
+            info.pending.clear()
+        for spec in pending:
+            self._fail_task(spec, exc)
+
+    # ------------------------------------------------------- failure handling
+    def _on_worker_death(self, handle: WorkerHandle) -> None:
+        with self._lock:
+            if handle.conn not in self._conn_handles:
+                return  # already processed
+            self._conn_handles.pop(handle.conn, None)
+            self._conn_send_locks.pop(handle.conn, None)
+            inflight = dict(handle.inflight)
+            handle.inflight.clear()
+        try:
+            handle.conn.close()
+        except OSError:
+            pass
+        nm = self.nodes.get(handle.node_id)
+        if nm:
+            nm.remove_worker(handle)
+        if handle.actor_id is not None:
+            self._on_actor_worker_death(handle, inflight)
+        else:
+            for task_id, spec in inflight.items():
+                self._maybe_retry(task_id, spec, WorkerCrashedError(
+                    f"worker {handle.worker_id} died running {spec.name}"
+                ))
+        if nm and nm.alive:
+            self._pump_node(nm)
+
+    def _maybe_retry(self, task_id: bytes, spec: TaskSpec,
+                     exc: Exception) -> None:
+        with self._lock:
+            rec = self.tasks.get(task_id)
+            can_retry = rec is not None and rec.retries_left > 0
+            if can_retry:
+                rec.retries_left -= 1
+        if can_retry:
+            self._resolve_deps_then_schedule(spec)
+        else:
+            self._fail_task(spec, exc)
+
+    def _on_actor_worker_death(self, handle: WorkerHandle,
+                               inflight: Dict[bytes, TaskSpec]) -> None:
+        with self._lock:
+            info = self.actors.get(handle.actor_id)
+        if info is None:
+            return
+        if info.record.state == ACTOR_DEAD:
+            for task_id, spec in inflight.items():
+                self._fail_task(spec, ActorDiedError(
+                    info.record.death_cause or "actor died"))
+            return
+        restartable = info.record.num_restarts < info.spec.max_restarts \
+            or info.spec.max_restarts == -1
+        if restartable:
+            info.record.num_restarts += 1
+            self.gcs.set_actor_state(info.record.actor_id, ACTOR_RESTARTING)
+            # GCS-driven restart (gcs_actor_manager.h:214 RestartActor):
+            # re-run the creation task; tasks in flight at the crash retry only
+            # under max_task_retries, queued ones wait for ALIVE.
+            with self._lock:
+                retry = sorted(inflight.values(), key=lambda s: s.seq)
+                for spec in retry:
+                    rec = self.tasks.get(spec.task_id)
+                    if rec and rec.retries_left > 0:
+                        rec.retries_left -= 1
+                        info.pending.appendleft(spec)
+                    else:
+                        self._fail_task(spec, ActorDiedError(
+                            "actor died while running task (no retries left)"
+                        ))
+                info.handle = None
+            self._request_pool.submit(self._start_actor, info)
+        else:
+            self.gcs.set_actor_state(
+                info.record.actor_id, ACTOR_DEAD, "worker process died"
+            )
+            self._release_actor_pg(info)
+            for task_id, spec in inflight.items():
+                self._fail_task(spec, ActorDiedError("actor worker died"))
+            self._fail_actor_queue(info, ActorDiedError("actor worker died"))
+
+    def _release_actor_pg(self, info: _ActorInfo) -> None:
+        if info.spec.placement is not None and self.pg_manager is not None:
+            self.pg_manager.release_key(info.spec.actor_id)
+
+    # ------------------------------------------------------------ heartbeats
+    def _heartbeat_loop(self) -> None:
+        interval = self.config.heartbeat_interval_s
+        timeout = interval * self.config.num_heartbeats_timeout
+        while not self._stop.is_set():
+            with self._lock:
+                nodes = list(self.nodes.values())
+            for nm in nodes:
+                if nm.alive:
+                    self.gcs.heartbeat(nm.node_id)
+            for node_id in self.gcs.check_heartbeats(timeout):
+                self.remove_node(node_id)
+            self._stop.wait(interval)
+
+    # ------------------------------------------------------------ object api
+    def put_object(self, value: Any) -> bytes:
+        data = ser.serialize(value)
+        oid = ObjectID.for_put().binary()
+        if data.total_size <= self.config.max_direct_call_object_size:
+            with self._lock:
+                self.memory_store[oid] = data.to_bytes()
+        else:
+            nm = self.head_node()
+            nm.store.put_serialized(oid, data)
+            self.gcs.add_object_location(oid, nm.node_id)
+        with self._lock:
+            fut = Future()
+            fut.set_result(True)
+            self.futures[oid] = fut
+        return oid
+
+    def put_serialized_arg(self, data: ser.SerializedObject) -> bytes:
+        """Promote an oversized call argument to a store object (the
+        plasma-promotion path of serialization.py:411 in the reference)."""
+        oid = ObjectID.for_put().binary()
+        nm = self.head_node()
+        nm.store.put_serialized(oid, data)
+        self.gcs.add_object_location(oid, nm.node_id)
+        with self._lock:
+            fut = Future()
+            fut.set_result(True)
+            self.futures[oid] = fut
+        return oid
+
+    def cancel_task(self, oid: bytes, force: bool = False) -> None:
+        self.cancel(oid, force)
+
+    def get_objects(self, oids: List[bytes],
+                    timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: Dict[bytes, Any] = {}
+        for oid in dict.fromkeys(oids):
+            out[oid] = self._get_one(oid, deadline)
+        results = []
+        for oid in oids:
+            v = out[oid]
+            if isinstance(v, Exception):
+                raise v
+            results.append(v)
+        return results
+
+    def _get_one(self, oid: bytes, deadline: Optional[float]):
+        for attempt in range(3):
+            with self._lock:
+                fut = self.futures.get(oid)
+            if fut is not None:
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                try:
+                    fut.result(timeout=remaining)
+                except TimeoutError:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {oid.hex()}"
+                    )
+                except Exception as e:
+                    return e
+            with self._lock:
+                data = self.memory_store.get(oid)
+            if data is not None:
+                return ser.loads(data)
+            value, found = self._read_from_stores(oid)
+            if found:
+                return value
+            # Not in memory, not in any store: lost. Try lineage recovery
+            # (ObjectRecoveryManager, object_recovery_manager.h:41).
+            try:
+                self._recover_object(oid)
+            except ObjectLostError as e:
+                return e
+        return ObjectLostError(oid.hex(), "recovery retries exhausted")
+
+    def _read_from_stores(self, oid: bytes) -> Tuple[Any, bool]:
+        locs = self.gcs.get_object_locations(oid)
+        for node_id in locs:
+            nm = self.nodes.get(node_id)
+            if nm is None or not nm.alive:
+                continue
+            cli = self._store_client_for(node_id)
+            view = cli.get(oid)
+            if view is None:
+                continue
+            # the store refcount taken by get() is held until the last
+            # zero-copy view of the value dies (plasma buffer semantics)
+            value = ser.deserialize(
+                view, on_release=lambda c=cli, o=oid: c.release(o)
+            )
+            return value, True
+        return None, False
+
+    def _recover_object(self, oid: bytes) -> None:
+        with self._lock:
+            task_id = self.lineage.get(oid)
+            rec = self.tasks.get(task_id) if task_id else None
+        if rec is None:
+            raise ObjectLostError(oid.hex(), "no lineage recorded")
+        spec = rec.spec
+        with self._lock:
+            # reset return futures so dependents re-wait
+            for roid in spec.return_ids:
+                fut = self.futures.get(roid)
+                if fut is None or fut.done():
+                    self.futures[roid] = Future()
+            rec.state = "RESUBMITTED"
+        self._resolve_deps_then_schedule(spec)
+        for roid in spec.return_ids:
+            with self._lock:
+                fut = self.futures[roid]
+            fut.result(timeout=self.config.worker_lease_timeout_s * 4)
+
+    def wait(self, oids: List[bytes], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[bytes] = []
+        pending = list(oids)
+        while True:
+            still = []
+            for oid in pending:
+                with self._lock:
+                    fut = self.futures.get(oid)
+                    present = oid in self.memory_store
+                if present or (fut is not None and fut.done()):
+                    ready.append(oid)
+                elif fut is None and self.gcs.get_object_locations(oid):
+                    ready.append(oid)
+                else:
+                    still.append(oid)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.001)
+        return ready[:num_returns] + ready[num_returns:], pending
+
+    def future_for(self, ref: ObjectRef) -> Future:
+        with self._lock:
+            fut = self.futures.get(ref.binary())
+            if fut is None:
+                fut = Future()
+                if ref.binary() in self.memory_store or \
+                        self.gcs.get_object_locations(ref.binary()):
+                    fut.set_result(True)
+                self.futures[ref.binary()] = fut
+            return fut
+
+    # ----------------------------------------------------- reference counting
+    def add_local_ref(self, oid: bytes) -> None:
+        with self._lock:
+            self.local_refs[oid] += 1
+
+    def remove_local_ref(self, oid: bytes) -> None:
+        with self._lock:
+            self.local_refs[oid] -= 1
+            if self.local_refs[oid] > 0:
+                return
+            del self.local_refs[oid]
+        self.free_object(oid)
+
+    def free_object(self, oid: bytes) -> None:
+        """Drop an object's value everywhere (ray.internal.free analog)."""
+        with self._lock:
+            self.memory_store.pop(oid, None)
+        for node_id in self.gcs.get_object_locations(oid):
+            nm = self.nodes.get(node_id)
+            if nm and nm.alive:
+                nm.store.delete(oid)
+            self.gcs.remove_object_location(oid, node_id)
+
+    # ------------------------------------------------------ worker requests
+    def _serve_worker_request(self, handle: WorkerHandle, msg: dict) -> None:
+        req_id = msg.get("req_id")
+        reply: dict = {"type": "reply", "req_id": req_id, "error": None}
+        try:
+            mtype = msg["type"]
+            if mtype == "submit_task":
+                reply["return_ids"] = self.submit_task(msg["payload"])
+            elif mtype == "submit_actor_task":
+                reply["return_ids"] = self.submit_actor_task(msg["payload"])
+            elif mtype == "create_actor":
+                reply["actor_id"] = self.create_actor(msg["payload"])
+            elif mtype == "get_objects":
+                reply["values"] = self._serve_get(handle, msg["oids"])
+            elif mtype == "put_inline":
+                oid = ObjectID.for_put().binary()
+                with self._lock:
+                    self.memory_store[oid] = msg["data"]
+                    fut = Future()
+                    fut.set_result(True)
+                    self.futures[oid] = fut
+                reply["object_id"] = oid
+            elif mtype == "reserve_put":
+                oid = ObjectID.for_put().binary()
+                reply["object_id"] = oid
+            elif mtype == "put_sealed":
+                oid = msg["object_id"]
+                self.gcs.add_object_location(oid, handle.node_id)
+                with self._lock:
+                    fut = self.futures.get(oid)
+                    if fut is None:
+                        self.futures[oid] = fut = Future()
+                if not fut.done():
+                    fut.set_result(True)
+                self._on_dep_ready(oid)
+            elif mtype == "wait":
+                ready, not_ready = self.wait(
+                    msg["oids"], msg["num_returns"], msg["timeout"]
+                )
+                reply["ready"] = ready
+                reply["not_ready"] = not_ready
+            elif mtype == "kill_actor":
+                self.kill_actor(msg["actor_id"], msg["no_restart"])
+            elif mtype == "cancel_task":
+                self.cancel(msg["object_id"], msg["force"])
+            elif mtype == "actor_info":
+                with self._lock:
+                    info = self.actors.get(msg["actor_id"])
+                reply["exists"] = info is not None
+            else:
+                raise ValueError(f"unknown worker request {mtype}")
+        except Exception as e:  # noqa: BLE001
+            try:
+                reply = {"type": "reply", "req_id": req_id,
+                         "error": ser.dumps(e)}
+            except Exception:
+                reply = {"type": "reply", "req_id": req_id,
+                         "error": ser.dumps(RuntimeError(str(e)))}
+        if not self._send(handle, reply):
+            self._on_worker_death(handle)
+
+    def _serve_get(self, handle: WorkerHandle, oids: List[bytes]):
+        """Make each object available to the requesting worker: inline bytes
+        for memory-store values, or ensure presence in the worker's node store
+        (transfer / spill-restore / lineage recovery)."""
+        values = []
+        for oid in oids:
+            with self._lock:
+                fut = self.futures.get(oid)
+            if fut is not None and not fut.done():
+                fut.result(timeout=3600)
+            with self._lock:
+                data = self.memory_store.get(oid)
+            if data is not None:
+                values.append(("v", data))
+                continue
+            node_id = handle.node_id
+            nm = self.nodes[node_id]
+            if not nm.store.contains(oid):
+                locs = [l for l in self.gcs.get_object_locations(oid)
+                        if l != node_id and self.nodes.get(l)
+                        and self.nodes[l].alive]
+                if locs:
+                    self._transfer_object(oid, locs[0], node_id)
+                else:
+                    self._recover_object(oid)
+                    # recovery may produce an inline value
+                    with self._lock:
+                        data = self.memory_store.get(oid)
+                    if data is not None:
+                        values.append(("v", data))
+                        continue
+                    if not nm.store.contains(oid):
+                        locs = [l for l in self.gcs.get_object_locations(oid)
+                                if self.nodes.get(l) and self.nodes[l].alive]
+                        if not locs:
+                            raise ObjectLostError(oid.hex())
+                        self._transfer_object(oid, locs[0], node_id)
+            values.append(("local", b""))
+        return values
+
+    # ---------------------------------------------------------------- cancel
+    def cancel(self, oid: bytes, force: bool = False) -> None:
+        """Best-effort cancel of a queued (not yet dispatched) task
+        (CoreWorker::CancelTask analog; running tasks are only killed with
+        force=True, which terminates the worker)."""
+        with self._lock:
+            task_id = self.lineage.get(oid)
+            if task_id is None:
+                return
+            self._cancelled.add(task_id)
+            rec = self.tasks.get(task_id)
+        for nm in self.nodes.values():
+            with nm._lock:
+                for spec in list(nm.queue):
+                    if spec.task_id == task_id:
+                        nm.queue.remove(spec)
+                        self._fail_task(spec, TaskError(
+                            spec.name, None, "cancelled"))
+                        return
+        if force and rec is not None:
+            for nm in self.nodes.values():
+                for h in list(nm.workers.values()):
+                    if task_id in h.inflight:
+                        h.proc.terminate()
+                        return
+
+    # -------------------------------------------------------------- shutdown
+    def _atexit_shutdown(self) -> None:
+        try:
+            if not self._stop.is_set():
+                self.shutdown()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wakeup()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=1.0)
+        try:
+            os.unlink(self._socket_path)
+        except OSError:
+            pass
+        self._router.join(timeout=2.0)
+        self._hb.join(timeout=2.0)
+        self._request_pool.shutdown(wait=False, cancel_futures=True)
+        self._transfer_pool.shutdown(wait=False, cancel_futures=True)
+        for nm in self.nodes.values():
+            try:
+                nm.shutdown(unlink_store=True)
+            except Exception:
+                pass
+        for cli in self._store_clients.values():
+            if isinstance(cli, StoreClient):
+                try:
+                    cli.close()
+                except Exception:
+                    pass
+        with self._lock:
+            self.memory_store.clear()
+        try:
+            os.close(self._wakeup_r)
+            os.close(self._wakeup_w)
+        except OSError:
+            pass
+        _worker_context.set_runtime(None)

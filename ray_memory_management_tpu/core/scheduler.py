@@ -1,0 +1,189 @@
+"""Cluster scheduler: node selection policies over GCS node state.
+
+The ClusterResourceScheduler / policy-set analog (src/ray/raylet/scheduling/):
+  - DEFAULT = hybrid pack-then-spread (policy/hybrid_scheduling_policy.h:48):
+    prefer low-index nodes while their utilization stays under the spread
+    threshold, then fall back to least-utilized.
+  - SPREAD = least utilized first (spread_scheduling_policy).
+  - NodeAffinity hard/soft (scheduling_strategies.py:41).
+  - Placement-group bundles reserve resources up front and tasks draw from the
+    bundle, not the free pool (placement_group_resource_manager.h) — handled
+    in placement_group.py, which calls back into this scheduler for the
+    initial bundle placement with PACK/SPREAD/STRICT_* policies
+    (bundle_scheduling_policy.h:82-109).
+
+Unlike the reference there is no per-node spillback hop (the two-level
+lease protocol, raylet_client.h:398): scheduling is centralized with the
+owner, which is exact — not an approximation — for a single driver.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..config import Config
+from ..ids import NodeID
+from .gcs import GCS
+from .resources import NodeResources, Resources
+from .scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SPREAD,
+)
+
+
+class ClusterScheduler:
+    def __init__(self, gcs: GCS, config: Optional[Config] = None):
+        self.gcs = gcs
+        self.config = config or Config()
+        self._lock = threading.RLock()
+        self._rr_counter = 0
+
+    # -- policy entry ---------------------------------------------------------
+    def pick_node(self, req: Resources, strategy=None) -> Optional[NodeID]:
+        """Select a node with available resources, or None if none can host
+        the task *right now*. Raises ValueError if no alive node could EVER
+        host it (infeasible — the reference surfaces this as a pending
+        infeasible task warning)."""
+        with self._lock:
+            nodes = self.gcs.alive_nodes()
+            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                raise RuntimeError(
+                    "PG strategies are resolved by PlacementGroupManager"
+                )
+            if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                target = next(
+                    (n for n in nodes if n.node_id == strategy.node_id), None
+                )
+                if target and target.resources.can_fit(req):
+                    return target.node_id
+                if target and target.resources.is_feasible(req):
+                    return None  # wait for resources on the pinned node
+                if not strategy.soft:
+                    raise ValueError(
+                        f"node affinity unsatisfiable for {strategy.node_id}"
+                    )
+                # soft: fall through to default policy
+            feasible = [n for n in nodes if n.resources.is_feasible(req)]
+            if not feasible:
+                raise ValueError(
+                    f"infeasible resource request {req.to_dict()}: no alive "
+                    f"node can ever satisfy it"
+                )
+            fitting = [n for n in feasible if n.resources.can_fit(req)]
+            if not fitting:
+                return None
+            if strategy == SPREAD:
+                self._rr_counter += 1
+                n_fit = len(fitting)
+                rr = self._rr_counter
+                fitting.sort(
+                    key=lambda n: (n.resources.utilization(),
+                                   (n.index + rr) % n_fit)
+                )
+                return fitting[0].node_id
+            # hybrid: pack onto lowest-index node under the threshold, else
+            # least-utilized (hybrid_scheduling_policy.h:48)
+            threshold = self.config.scheduler_spread_threshold
+            under = [n for n in fitting
+                     if n.resources.utilization() < threshold]
+            if under:
+                return min(under, key=lambda n: n.index).node_id
+            return min(fitting, key=lambda n: n.resources.utilization()).node_id
+
+    # -- resource accounting --------------------------------------------------
+    def allocate(self, node_id: NodeID, req: Resources) -> None:
+        with self._lock:
+            self.gcs.nodes[node_id].resources.allocate(req)
+
+    def free(self, node_id: NodeID, req: Resources) -> None:
+        with self._lock:
+            info = self.gcs.nodes.get(node_id)
+            if info is not None:
+                info.resources.free(req)
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self.gcs.alive_nodes():
+            for k, v in n.resources.total.to_dict().items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self.gcs.alive_nodes():
+            for k, v in n.resources.available.to_dict().items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    # -- bundle placement (used by PlacementGroupManager) ---------------------
+    def place_bundles(
+        self, bundles: List[Resources], policy: str
+    ) -> Optional[List[NodeID]]:
+        """Choose a node per bundle under PACK/SPREAD/STRICT_PACK/
+        STRICT_SPREAD (bundle_scheduling_policy.h:82-109). Returns None if
+        unplaceable now. Resources are NOT allocated here — the PG manager
+        commits them (two-phase prepare/commit, as in the reference)."""
+        with self._lock:
+            nodes = self.gcs.alive_nodes()
+            avail = {
+                n.node_id: Resources.from_fixed(
+                    n.resources.available.fixed()
+                )
+                for n in nodes
+            }
+            order = sorted(nodes, key=lambda n: n.index)
+
+            def fit_on(node_id, req) -> bool:
+                return req.fits_in(avail[node_id])
+
+            def take(node_id, req):
+                avail[node_id] = avail[node_id] - req
+
+            result: List[Optional[NodeID]] = []
+            if policy == "STRICT_PACK":
+                for n in order:
+                    trial = Resources.from_fixed(avail[n.node_id].fixed())
+                    ok = True
+                    for b in bundles:
+                        if b.fits_in(trial):
+                            trial = trial - b
+                        else:
+                            ok = False
+                            break
+                    if ok:
+                        return [n.node_id] * len(bundles)
+                return None
+            if policy == "STRICT_SPREAD":
+                used: set = set()
+                for b in bundles:
+                    cand = next(
+                        (n for n in order
+                         if n.node_id not in used and fit_on(n.node_id, b)),
+                        None,
+                    )
+                    if cand is None:
+                        return None
+                    used.add(cand.node_id)
+                    take(cand.node_id, b)
+                    result.append(cand.node_id)
+                return result
+            if policy == "SPREAD":
+                for b in bundles:
+                    cands = [n for n in order if fit_on(n.node_id, b)]
+                    if not cands:
+                        return None
+                    counts = {n.node_id: result.count(n.node_id) for n in cands}
+                    cand = min(cands, key=lambda n: (counts[n.node_id], n.index))
+                    take(cand.node_id, b)
+                    result.append(cand.node_id)
+                return result
+            # PACK (default): fill low-index nodes first
+            for b in bundles:
+                cand = next((n for n in order if fit_on(n.node_id, b)), None)
+                if cand is None:
+                    return None
+                take(cand.node_id, b)
+                result.append(cand.node_id)
+            return result

@@ -1,0 +1,47 @@
+"""Scheduling strategies, mirroring python/ray/util/scheduling_strategies.py:15,41."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+DEFAULT = "DEFAULT"  # hybrid pack-then-spread (hybrid_scheduling_policy.h:48)
+SPREAD = "SPREAD"    # least-utilized spread (spread_scheduling_policy)
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node; ``soft=True`` allows fallback when the node is gone
+    (scheduling_strategies.py:41)."""
+
+    def __init__(self, node_id, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+    def __repr__(self):
+        return f"NodeAffinity({self.node_id}, soft={self.soft})"
+
+
+class PlacementGroupSchedulingStrategy:
+    """Run inside a placement-group bundle (scheduling_strategies.py:15)."""
+
+    def __init__(
+        self,
+        placement_group,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+
+class TopologySchedulingStrategy:
+    """TPU-native addition: request ICI-contiguous placement.
+
+    The reference's scheduler is topology-blind (SURVEY.md §7 hard parts); on
+    TPU pods, ICI adjacency is a first-class scheduling dimension. ``form``
+    selects the desired chip/host adjacency, e.g. "ici-ring" or "ici-torus-2d".
+    """
+
+    def __init__(self, form: str = "ici-ring", slice_name: Optional[str] = None):
+        self.form = form
+        self.slice_name = slice_name

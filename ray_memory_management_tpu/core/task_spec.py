@@ -1,0 +1,110 @@
+"""Task and actor specifications shipped from owner to worker.
+
+The analog of the reference's TaskSpecification (src/ray/common/task/task_spec.h:159)
+— but as a plain Python object sent over the worker pipe rather than a protobuf,
+since the worker boundary here is a same-host process. Arguments are encoded as
+either inline serialized bytes or object references, mirroring the reference's
+inlining rules (task_rpc_inlined_bytes_limit, ray_config_def.h:424).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# Argument encodings: ("v", <serialized bytes>) inline value,
+#                     ("ref", <object id bytes>) store reference.
+Arg = Tuple[str, bytes]
+
+
+class TaskSpec:
+    __slots__ = (
+        "task_id", "name", "fn_id", "args", "kwargs", "num_returns",
+        "return_ids", "resources", "strategy", "max_retries",
+        "retry_exceptions", "actor_id", "method", "seq",
+        "runtime_env", "placement", "depth",
+    )
+
+    def __init__(
+        self,
+        task_id: bytes,
+        name: str,
+        fn_id: bytes,
+        args: List[Arg],
+        kwargs: Dict[str, Arg],
+        num_returns: int,
+        return_ids: List[bytes],
+        resources: Dict[str, float],
+        strategy: Any = None,
+        max_retries: int = 0,
+        retry_exceptions: bool = False,
+        actor_id: Optional[bytes] = None,
+        method: Optional[str] = None,
+        seq: int = 0,
+        runtime_env: Optional[dict] = None,
+        placement: Optional[tuple] = None,  # (pg_id_bytes, bundle_index)
+        depth: int = 0,
+    ):
+        self.task_id = task_id
+        self.name = name
+        self.fn_id = fn_id
+        self.args = args
+        self.kwargs = kwargs
+        self.num_returns = num_returns
+        self.return_ids = return_ids
+        self.resources = resources
+        self.strategy = strategy
+        self.max_retries = max_retries
+        self.retry_exceptions = retry_exceptions
+        self.actor_id = actor_id
+        self.method = method
+        self.seq = seq
+        self.runtime_env = runtime_env
+        self.placement = placement
+        self.depth = depth
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and self.method is not None
+
+    def __repr__(self):
+        return f"TaskSpec({self.name}, id={self.task_id.hex()[:8]})"
+
+
+class ActorCreationSpec:
+    __slots__ = (
+        "actor_id", "name", "cls_id", "args", "kwargs", "resources",
+        "strategy", "max_restarts", "max_task_retries", "max_concurrency",
+        "runtime_env", "placement", "detached", "registered_name",
+    )
+
+    def __init__(
+        self,
+        actor_id: bytes,
+        name: str,
+        cls_id: bytes,
+        args: List[Arg],
+        kwargs: Dict[str, Arg],
+        resources: Dict[str, float],
+        strategy: Any = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        runtime_env: Optional[dict] = None,
+        placement: Optional[tuple] = None,
+        detached: bool = False,
+        registered_name: Optional[str] = None,
+    ):
+        self.actor_id = actor_id
+        self.name = name
+        self.cls_id = cls_id
+        self.args = args
+        self.kwargs = kwargs
+        self.resources = resources
+        self.strategy = strategy
+        self.max_restarts = max_restarts
+        self.max_task_retries = max_task_retries
+        self.max_concurrency = max_concurrency
+        self.runtime_env = runtime_env
+        self.placement = placement
+        self.detached = detached
+        self.registered_name = registered_name

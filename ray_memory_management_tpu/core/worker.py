@@ -1,0 +1,433 @@
+"""Worker process: executes tasks and hosts actors.
+
+The analog of the reference's worker side of CoreWorker (task execution path
+src/ray/core_worker/core_worker.cc:2181 → python/ray/_raylet.pyx:850,533) plus
+the worker main loop (_raylet.pyx:1226 run_task_loop). Differences driven by
+the TPU host-process model:
+
+  - Transport is a same-host pipe to the driver-side node manager, not gRPC;
+    args/returns ride the shared-memory store exactly like plasma.
+  - The worker doubles as the reference's "IO worker" and nested-call client:
+    tasks running here may call ``remote()``/``get()``/``put()``, which are
+    proxied over the pipe to the owner runtime (the reference gives every
+    worker a full CoreWorker; centralizing ownership in the driver is a
+    single-host simplification, revisited for multi-host in the DCN plane).
+  - Accelerator isolation: an exec message may carry ``visible_chips``; the
+    worker exports ``TPU_VISIBLE_CHIPS`` before user code imports jax — the
+    TPU analog of per-task CUDA_VISIBLE_DEVICES (_raylet.pyx:563).
+
+Concurrency: the main thread is a pure receive loop. Normal tasks and each
+actor run on their own serial executor (max_concurrency>1 widens the actor's
+pool — concurrency groups, reference concurrency_group_manager.h); ``async
+def`` actor methods run on a per-actor asyncio loop thread (fiber.h analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from .. import serialization as ser
+from .object_store import StoreClient
+
+
+class _ReplySender:
+    def __init__(self, conn):
+        self._conn = conn
+        self._lock = threading.Lock()
+
+    def send(self, msg: dict) -> None:
+        with self._lock:
+            self._conn.send(msg)
+
+
+class WorkerRuntimeProxy:
+    """Driver-runtime facade available to user code running in this worker.
+
+    Implements submit/get/put/wait by round-tripping requests to the owner
+    over the worker pipe; the driver's router thread services them.
+    """
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._pending: Dict[int, Any] = {}
+        self._events: Dict[int, threading.Event] = {}
+        self._req_counter = 0
+        self._lock = threading.Lock()
+
+    def _request(self, msg: dict, timeout: Optional[float] = None):
+        with self._lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            ev = threading.Event()
+            self._events[req_id] = ev
+        msg["req_id"] = req_id
+        self._worker.sender.send(msg)
+        if not ev.wait(timeout if timeout is not None else 3600.0):
+            raise TimeoutError(f"worker request {msg['type']} timed out")
+        with self._lock:
+            reply = self._pending.pop(req_id)
+            self._events.pop(req_id, None)
+        if reply.get("error") is not None:
+            raise ser.loads(reply["error"])
+        return reply
+
+    def deliver(self, reply: dict) -> None:
+        req_id = reply["req_id"]
+        with self._lock:
+            self._pending[req_id] = reply
+            ev = self._events.get(req_id)
+        if ev:
+            ev.set()
+
+    # -- API used by core.api when running inside a worker --------------------
+    def submit_task(self, payload: dict) -> List[bytes]:
+        reply = self._request({"type": "submit_task", "payload": payload})
+        return reply["return_ids"]
+
+    def submit_actor_task(self, payload: dict) -> List[bytes]:
+        reply = self._request({"type": "submit_actor_task", "payload": payload})
+        return reply["return_ids"]
+
+    def create_actor(self, payload: dict) -> bytes:
+        reply = self._request({"type": "create_actor", "payload": payload})
+        return reply["actor_id"]
+
+    def get_objects(self, oids: List[bytes], timeout: Optional[float] = None):
+        """Resolve objects: local store first, else ask the owner (which
+        transfers/restores/replies inline for memory-store values)."""
+        out: Dict[bytes, Any] = {}
+        missing: List[bytes] = []
+        for oid in set(oids):
+            view = self._worker.store.get(oid)
+            if view is not None:
+                out[oid] = self._worker.decode_value(view, pin=oid)
+            else:
+                missing.append(oid)
+        if missing:
+            reply = self._request(
+                {"type": "get_objects", "oids": missing}, timeout=timeout
+            )
+            for oid, enc in zip(missing, reply["values"]):
+                if enc[0] == "v":
+                    out[oid] = ser.loads(enc[1])
+                else:  # now present in the local store
+                    view = self._worker.store.get(oid)
+                    if view is None:
+                        raise RuntimeError(
+                            f"owner reported {oid.hex()} local but store miss"
+                        )
+                    out[oid] = self._worker.decode_value(view, pin=oid)
+        return [out[oid] for oid in oids]
+
+    def put_object(self, value: Any) -> bytes:
+        data = ser.serialize(value)
+        if data.total_size <= self._worker.inline_limit:
+            reply = self._request(
+                {"type": "put_inline", "data": data.to_bytes()}
+            )
+            return reply["object_id"]
+        reply = self._request(
+            {"type": "reserve_put", "size": data.total_size}
+        )
+        oid = reply["object_id"]
+        self._worker.store.put_serialized(oid, data)
+        self._request({"type": "put_sealed", "object_id": oid})
+        return oid
+
+    def put_serialized_arg(self, data) -> bytes:
+        if data.total_size <= self._worker.inline_limit:
+            reply = self._request({"type": "put_inline",
+                                   "data": data.to_bytes()})
+            return reply["object_id"]
+        reply = self._request({"type": "reserve_put", "size": data.total_size})
+        oid = reply["object_id"]
+        self._worker.store.put_serialized(oid, data)
+        self._request({"type": "put_sealed", "object_id": oid})
+        return oid
+
+    def wait(self, oids: List[bytes], num_returns: int, timeout, fetch_local):
+        reply = self._request({
+            "type": "wait", "oids": oids, "num_returns": num_returns,
+            "timeout": timeout,
+        }, timeout=None if timeout is None else timeout + 5)
+        return reply["ready"], reply["not_ready"]
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool) -> None:
+        self._request({"type": "kill_actor", "actor_id": actor_id,
+                       "no_restart": no_restart})
+
+    def cancel_task(self, oid: bytes, force: bool) -> None:
+        self._request({"type": "cancel_task", "object_id": oid,
+                       "force": force})
+
+    def actor_method_spec(self, actor_id: bytes):
+        reply = self._request({"type": "actor_info", "actor_id": actor_id})
+        return reply
+
+
+class _ActorState:
+    def __init__(self, instance, max_concurrency: int):
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.executor = ThreadPoolExecutor(
+            max_workers=max_concurrency, thread_name_prefix="actor"
+        )
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.loop_thread: Optional[threading.Thread] = None
+
+    def ensure_loop(self) -> asyncio.AbstractEventLoop:
+        if self.loop is None:
+            self.loop = asyncio.new_event_loop()
+            self.loop_thread = threading.Thread(
+                target=self.loop.run_forever, daemon=True, name="actor-asyncio"
+            )
+            self.loop_thread.start()
+        return self.loop
+
+
+class Worker:
+    def __init__(self, conn, worker_id: bytes, node_id: bytes,
+                 store_name: str, inline_limit: int):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.store = StoreClient(store_name)
+        self.inline_limit = inline_limit
+        self.sender = _ReplySender(conn)
+        self.proxy = WorkerRuntimeProxy(self)
+        self.functions: Dict[bytes, Any] = {}
+        self.classes: Dict[bytes, Any] = {}
+        self.actors: Dict[bytes, _ActorState] = {}
+        self.task_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task"
+        )
+        self._shutdown = threading.Event()
+
+    # -- value encoding -------------------------------------------------------
+    def decode_value(self, view: memoryview, pin: Optional[bytes] = None):
+        """Deserialize from a store view. The view stays referenced by any
+        zero-copy numpy arrays; we release our store ref only after the task
+        completes (args are pinned for the task's duration, as the raylet pins
+        task args — local_task_manager.cc:388)."""
+        return ser.deserialize(view)
+
+    def decode_args(self, args, kwargs):
+        pinned: List[bytes] = []
+
+        def decode(enc):
+            kind, payload = enc
+            if kind == "v":
+                return ser.loads(payload)
+            view = self.store.get(payload)
+            if view is None:
+                # Not local (spilled elsewhere / other node): owner will fix.
+                value = self.proxy.get_objects([payload])[0]
+                return value
+            pinned.append(payload)
+            return ser.deserialize(view)
+
+        pos = [decode(a) for a in args]
+        kw = {k: decode(v) for k, v in kwargs.items()}
+        return pos, kw, pinned
+
+    def encode_returns(self, values: List[Any], return_ids: List[bytes]):
+        """Small returns inline in the reply (owner memory store); big ones go
+        straight to shm (core_worker.cc:892 PutInLocalPlasmaStore analog)."""
+        encoded = []
+        for value, oid in zip(values, return_ids):
+            data = ser.serialize(value)
+            if data.total_size <= self.inline_limit:
+                encoded.append((oid, "v", data.to_bytes()))
+            else:
+                self.store.put_serialized(oid, data)
+                encoded.append((oid, "store", data.total_size))
+        return encoded
+
+    # -- execution ------------------------------------------------------------
+    @staticmethod
+    def _apply_chip_lease(msg: dict) -> None:
+        """Export the leased chips before user code imports jax — the TPU
+        analog of per-task CUDA_VISIBLE_DEVICES (_raylet.pyx:563). The pool
+        pins workers to JAX_PLATFORMS=cpu by default; a chip lease lifts that
+        so jax can claim the TPU."""
+        chips = msg.get("visible_chips")
+        if chips is not None:
+            os.environ["TPU_VISIBLE_CHIPS"] = chips
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                del os.environ["JAX_PLATFORMS"]
+
+    def _resolve_function(self, msg) -> Any:
+        fn_id = msg["fn_id"]
+        fn = self.functions.get(fn_id)
+        if fn is None:
+            blob = msg.get("fn_blob")
+            if blob is None:
+                raise RuntimeError(f"function {fn_id.hex()} not registered")
+            import cloudpickle
+
+            fn = cloudpickle.loads(blob)
+            self.functions[fn_id] = fn
+        return fn
+
+    def exec_task(self, msg: dict) -> None:
+        task_id = msg["task_id"]
+        pinned: List[bytes] = []
+        try:
+            self._apply_chip_lease(msg)
+            fn = self._resolve_function(msg)
+            args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
+            result = fn(*args, **kwargs)
+            returns = self._split_returns(result, msg["return_ids"])
+            reply = {
+                "type": "done", "task_id": task_id,
+                "returns": self.encode_returns(returns, msg["return_ids"]),
+                "error": None,
+            }
+        except BaseException as e:  # noqa: BLE001 — errors travel to the owner
+            reply = {
+                "type": "done", "task_id": task_id, "returns": [],
+                "error": self._encode_error(msg.get("name", "task"), e),
+            }
+        finally:
+            for oid in pinned:
+                self.store.release(oid)
+        self.sender.send(reply)
+
+    @staticmethod
+    def _split_returns(result, return_ids):
+        n = len(return_ids)
+        if n == 1:
+            return [result]
+        if not isinstance(result, (tuple, list)) or len(result) != n:
+            raise ValueError(
+                f"task declared num_returns={n} but returned {type(result)}"
+            )
+        return list(result)
+
+    @staticmethod
+    def _encode_error(name: str, e: BaseException) -> bytes:
+        from ..exceptions import TaskError
+
+        if isinstance(e, TaskError):  # propagate the original site
+            return ser.dumps(e)
+        tb = "".join(traceback.format_exception(e))
+        try:
+            return ser.dumps(TaskError(name, e, tb))
+        except Exception:
+            return ser.dumps(TaskError(name, None, tb))
+
+    def create_actor(self, msg: dict) -> None:
+        actor_id = msg["actor_id"]
+        try:
+            self._apply_chip_lease(msg)
+            cls_id = msg["cls_id"]
+            cls = self.classes.get(cls_id)
+            if cls is None:
+                import cloudpickle
+
+                cls = cloudpickle.loads(msg["cls_blob"])
+                self.classes[cls_id] = cls
+            args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
+            instance = cls(*args, **kwargs)
+            for oid in pinned:
+                self.store.release(oid)
+            self.actors[actor_id] = _ActorState(
+                instance, msg.get("max_concurrency", 1)
+            )
+            reply = {"type": "actor_created", "actor_id": actor_id,
+                     "error": None}
+        except BaseException as e:  # noqa: BLE001
+            reply = {"type": "actor_created", "actor_id": actor_id,
+                     "error": self._encode_error(msg.get("name", "actor"), e)}
+        self.sender.send(reply)
+
+    def exec_actor_task(self, msg: dict) -> None:
+        task_id = msg["task_id"]
+        state = self.actors.get(msg["actor_id"])
+        if state is None:
+            self.sender.send({
+                "type": "done", "task_id": task_id, "returns": [],
+                "error": self._encode_error(
+                    msg.get("name", "actor-task"),
+                    RuntimeError("actor not found on worker"),
+                ),
+            })
+            return
+        method = getattr(state.instance, msg["method"], None)
+        if method is None:
+            self.sender.send({
+                "type": "done", "task_id": task_id, "returns": [],
+                "error": self._encode_error(
+                    msg["method"], AttributeError(msg["method"])),
+            })
+            return
+        pinned: List[bytes] = []
+        try:
+            args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
+            if inspect.iscoroutinefunction(method):
+                loop = state.ensure_loop()
+                fut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), loop
+                )
+                result = fut.result()
+            else:
+                result = method(*args, **kwargs)
+            returns = self._split_returns(result, msg["return_ids"])
+            reply = {
+                "type": "done", "task_id": task_id,
+                "returns": self.encode_returns(returns, msg["return_ids"]),
+                "error": None,
+            }
+        except BaseException as e:  # noqa: BLE001
+            reply = {"type": "done", "task_id": task_id, "returns": [],
+                     "error": self._encode_error(msg["method"], e)}
+        finally:
+            for oid in pinned:
+                self.store.release(oid)
+        self.sender.send(reply)
+
+    # -- main loop ------------------------------------------------------------
+    def run(self) -> None:
+        from .. import _worker_context
+
+        _worker_context.set_proxy(self.proxy)
+        # registration doubles as the ready signal (exec-then-connect
+        # handshake; the runtime binds this connection to our WorkerHandle)
+        self.sender.send({"type": "ready", "worker_id": self.worker_id,
+                          "node_id": self.node_id, "pid": os.getpid()})
+        while not self._shutdown.is_set():
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            mtype = msg["type"]
+            if mtype == "exec":
+                self.task_executor.submit(self.exec_task, msg)
+            elif mtype == "exec_actor":
+                state = self.actors.get(msg["actor_id"])
+                executor = state.executor if state else self.task_executor
+                executor.submit(self.exec_actor_task, msg)
+            elif mtype == "create_actor":
+                self.task_executor.submit(self.create_actor, msg)
+            elif mtype == "reply":
+                self.proxy.deliver(msg)
+            elif mtype == "ping":
+                self.sender.send({"type": "pong"})
+            elif mtype == "shutdown":
+                break
+        os._exit(0)  # skip atexit: the store mapping may hold live views
+
+
+def worker_entry(conn, worker_id: bytes, node_id: bytes, store_name: str,
+                 inline_limit: int, env: Optional[dict] = None) -> None:
+    """Entry point run in the spawned worker process (worker_pool starts us —
+    the WorkerPool::StartWorkerProcess analog, worker_pool.h:427)."""
+    if env:
+        os.environ.update(env)
+    Worker(conn, worker_id, node_id, store_name, inline_limit).run()

@@ -1,0 +1,43 @@
+"""Worker process entry point: ``python -m ray_memory_management_tpu.core.worker_main``.
+
+Launched by the node manager's worker pool; connects back to the driver
+runtime over its Unix socket (the reference's worker registers with the raylet
+over its socket at startup, raylet_client.h:236) and enters the task loop.
+Configuration arrives via RMT_* environment variables so no argv parsing or
+pickling of startup state is needed.
+"""
+
+from __future__ import annotations
+
+import os
+from multiprocessing.connection import Client
+
+
+def main() -> None:
+    worker_id = bytes.fromhex(os.environ["RMT_WORKER_ID"])
+    node_id = bytes.fromhex(os.environ["RMT_NODE_ID"])
+    store_name = os.environ["RMT_STORE_NAME"]
+    socket_path = os.environ["RMT_SOCKET"]
+    authkey = bytes.fromhex(os.environ["RMT_AUTHKEY"])
+    inline_limit = int(os.environ["RMT_INLINE_LIMIT"])
+
+    import time
+
+    conn = None
+    for attempt in range(3):
+        try:
+            conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
+            break
+        except (FileNotFoundError, ConnectionRefusedError):
+            # runtime already shut down (or not yet listening): exit quietly —
+            # we are a pooled worker nobody will miss
+            time.sleep(0.1 * (attempt + 1))
+    if conn is None:
+        return
+    from .worker import Worker
+
+    Worker(conn, worker_id, node_id, store_name, inline_limit).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -117,22 +117,81 @@ def serialize(value: Any) -> SerializedObject:
     return SerializedObject(header, pickled, views)
 
 
-def deserialize(data: memoryview | bytes) -> Any:
-    mv = memoryview(data)
-    if bytes(mv[: len(_MAGIC)]) != _MAGIC:
-        raise ValueError("corrupt object envelope (bad magic)")
-    meta_len = int.from_bytes(mv[len(_MAGIC) : len(_MAGIC) + 8], "little")
-    meta_start = len(_MAGIC) + 8
-    meta = msgpack.unpackb(mv[meta_start : meta_start + meta_len])
-    pos = meta_start + meta_len
-    pickled = mv[pos : pos + meta["pickle_len"]]
-    pos += meta["pickle_len"]
-    buffers: List[memoryview] = []
-    for size in meta["buf_sizes"]:
-        pos = _align(pos)
-        buffers.append(mv[pos : pos + size])  # zero-copy view into the mapping
-        pos += size
-    return pickle.loads(pickled, buffers=buffers)
+class _StoreBufferView:
+    """PEP-688 buffer wrapper tying a store refcount to view lifetime.
+
+    numpy/pickle keep the wrapper alive as the ``base`` of every zero-copy
+    array deserialized from the store; when the last view dies, ``notify``
+    fires and the caller releases its store reference — exactly the plasma
+    client's buffer-lifetime semantics (plasma/client.cc Release on buffer
+    destruction). Views are read-only, matching plasma's sealed-object rule.
+    """
+
+    __slots__ = ("_mv", "_notify")
+
+    def __init__(self, mv: memoryview, notify):
+        self._mv = mv
+        self._notify = notify
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+    def __del__(self):
+        if self._notify is not None:
+            self._notify()
+
+
+def deserialize(data: memoryview | bytes, on_release=None) -> Any:
+    """Deserialize an envelope. If ``on_release`` is given, it is called once
+    all zero-copy views into ``data`` are garbage (immediately if there are
+    none, and also if deserialization fails before any view is handed out) —
+    used by store readers to drop their refcount safely. All zero-copy views
+    are read-only (plasma's sealed-object rule)."""
+    wrappers_made = False
+    try:
+        mv = memoryview(data)
+        if bytes(mv[: len(_MAGIC)]) != _MAGIC:
+            raise ValueError("corrupt object envelope (bad magic)")
+        meta_len = int.from_bytes(mv[len(_MAGIC) : len(_MAGIC) + 8], "little")
+        meta_start = len(_MAGIC) + 8
+        meta = msgpack.unpackb(mv[meta_start : meta_start + meta_len])
+        pos = meta_start + meta_len
+        pickled = mv[pos : pos + meta["pickle_len"]]
+        pos += meta["pickle_len"]
+        buffers: List[Any] = []
+        notify = None
+        if on_release is not None and meta["buf_sizes"]:
+            import threading
+
+            remaining = [len(meta["buf_sizes"])]
+            notify_lock = threading.Lock()
+
+            def notify():  # noqa: ANN001 — fires from __del__ on any thread
+                with notify_lock:
+                    remaining[0] -= 1
+                    fire = remaining[0] == 0
+                if fire:
+                    on_release()
+
+        for size in meta["buf_sizes"]:
+            pos = _align(pos)
+            sl = mv[pos : pos + size].toreadonly()  # zero-copy, read-only
+            if notify is not None:
+                buffers.append(_StoreBufferView(sl, notify))
+            else:
+                buffers.append(sl)
+            pos += size
+        wrappers_made = notify is not None
+        value = pickle.loads(pickled, buffers=buffers)
+        if on_release is not None and not meta["buf_sizes"]:
+            on_release()
+        return value
+    except BaseException:
+        # No wrapper will ever fire notify on a pre-wrapper failure: release
+        # the caller's store ref here so the object is not pinned forever.
+        if on_release is not None and not wrappers_made:
+            on_release()
+        raise
 
 
 def dumps(value: Any) -> bytes:

@@ -1,0 +1,14 @@
+"""Utility surface, mirroring python/ray/util/ (placement groups, actor pool,
+queue, metrics, scheduling strategies)."""
+
+from ..core.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ..core.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    TopologySchedulingStrategy,
+)

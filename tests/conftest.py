@@ -1,0 +1,46 @@
+"""Test fixtures: in-process multi-node clusters (the reference's
+ray_start_regular / ray_start_cluster fixtures, python/ray/tests/conftest.py:203-348).
+
+jax-facing tests run on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) so multi-chip sharding logic is
+exercised without TPU hardware.
+"""
+
+import os
+
+# must be set before jax initializes its backends
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+import ray_memory_management_tpu as rmt  # noqa: E402
+
+
+@pytest.fixture
+def rmt_start_regular():
+    rt = rmt.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    rmt.shutdown()
+
+
+@pytest.fixture
+def rmt_start_cluster():
+    """3-node virtual cluster, 4 CPUs each."""
+    rt = rmt.init(num_cpus=4, num_nodes=3)
+    yield rt
+    rmt.shutdown()
+
+
+@pytest.fixture
+def rmt_small_store():
+    from ray_memory_management_tpu.config import Config
+
+    cfg = Config(object_store_memory=64 << 20)
+    rt = rmt.init(num_cpus=4, _config=cfg)
+    yield rt
+    rmt.shutdown()

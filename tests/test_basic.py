@@ -1,0 +1,129 @@
+"""Core task/object API tests (the reference's test_basic.py /
+test_advanced.py coverage, python/ray/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_memory_management_tpu as rmt
+
+
+@rmt.remote
+def add(a, b):
+    return a + b
+
+
+@rmt.remote
+def make_array(n):
+    return np.arange(n, dtype=np.float32)
+
+
+def test_submit_and_get(rmt_start_regular):
+    assert rmt.get(add.remote(1, 2)) == 3
+
+
+def test_fanout(rmt_start_regular):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert rmt.get(refs) == [2 * i for i in range(50)]
+
+
+def test_large_object_zero_copy(rmt_start_regular):
+    a = rmt.get(make_array.remote(1_000_000))
+    assert a.dtype == np.float32 and a.shape == (1_000_000,)
+    # zero-copy from the shared-memory store: the array is a view, read-only
+    assert a.base is not None
+    assert not a.flags.writeable
+
+
+def test_put_get_roundtrip(rmt_start_regular):
+    for value in [1, "x", {"a": [1, 2]}, np.ones(300_000), None]:
+        ref = rmt.put(value)
+        out = rmt.get(ref)
+        if isinstance(value, np.ndarray):
+            assert np.array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_ref_args_chain(rmt_start_regular):
+    c = add.remote(add.remote(1, 1), add.remote(2, 2))
+    assert rmt.get(c) == 6
+
+
+def test_num_returns(rmt_start_regular):
+    @rmt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert rmt.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_exception_propagates(rmt_start_regular):
+    @rmt.remote(max_retries=0)
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(rmt.TaskError, match="kapow"):
+        rmt.get(boom.remote())
+
+
+def test_wait(rmt_start_regular):
+    refs = [add.remote(i, 1) for i in range(10)]
+    ready, rest = rmt.wait(refs, num_returns=5, timeout=30)
+    assert len(ready) == 5
+    assert len(ready) + len(rest) == 10
+    ready_all, rest_all = rmt.wait(refs, num_returns=10, timeout=30)
+    assert len(ready_all) == 10 and not rest_all
+
+
+def test_get_timeout(rmt_start_regular):
+    @rmt.remote
+    def slow():
+        import time
+
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(rmt.GetTimeoutError):
+        rmt.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(rmt_start_regular):
+    @rmt.remote
+    def outer(x):
+        return rmt.get(add.remote(x, 1)) * 2
+
+    assert rmt.get(outer.remote(4)) == 10
+
+
+def test_nested_put(rmt_start_regular):
+    @rmt.remote
+    def putter():
+        ref = rmt.put(np.ones(500_000))
+        return rmt.get(ref).sum()
+
+    assert rmt.get(putter.remote()) == 500_000.0
+
+
+def test_options_override(rmt_start_regular):
+    fast = add.options(num_cpus=2, name="fast_add")
+    assert rmt.get(fast.remote(2, 3)) == 5
+
+
+def test_cluster_and_available_resources(rmt_start_regular):
+    total = rmt.cluster_resources()
+    assert total["CPU"] == 4.0
+
+
+def test_cannot_call_remote_fn_directly(rmt_start_regular):
+    with pytest.raises(TypeError):
+        add(1, 2)
+
+
+def test_infeasible_task_fails(rmt_start_regular):
+    @rmt.remote(num_cpus=1000)
+    def huge():
+        return 1
+
+    with pytest.raises(rmt.TaskError, match="infeasible"):
+        rmt.get(huge.remote(), timeout=10)

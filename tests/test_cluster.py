@@ -1,0 +1,119 @@
+"""Multi-node scheduling, transfer, FT tests (reference:
+python/ray/tests/test_multinode_failures.py, test_object_spilling.py,
+test_reconstruction.py coverage — via the in-process cluster)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_memory_management_tpu as rmt
+
+
+@rmt.remote(scheduling_strategy="SPREAD")
+def whereami():
+    return os.environ["RMT_NODE_ID"]
+
+
+@rmt.remote(scheduling_strategy="SPREAD")
+def make(n):
+    return np.full(n, 7, dtype=np.float32)
+
+
+def test_spread_uses_multiple_nodes(rmt_start_cluster):
+    # occupy workers long enough that spreading is observable
+    @rmt.remote(scheduling_strategy="SPREAD")
+    def spot(t):
+        time.sleep(t)
+        return os.environ["RMT_NODE_ID"]
+
+    nodes = set(rmt.get([spot.remote(0.3) for _ in range(12)], timeout=120))
+    assert len(nodes) >= 2, nodes
+
+
+def test_cross_node_object_transfer(rmt_start_cluster):
+    @rmt.remote(scheduling_strategy="SPREAD")
+    def consume(a, b):
+        return float(a.sum() + b.sum())
+
+    a, b = make.remote(500_000), make.remote(500_000)
+    assert rmt.get(consume.remote(a, b), timeout=60) == 7.0 * 1_000_000
+
+
+def test_task_retry_on_worker_crash(rmt_start_cluster, tmp_path):
+    @rmt.remote(max_retries=4)
+    def flaky(path):
+        n = 0
+        if os.path.exists(path):
+            n = int(open(path).read())
+        open(path, "w").write(str(n + 1))
+        if n < 2:
+            os._exit(1)
+        return "survived"
+
+    p = str(tmp_path / "count")
+    assert rmt.get(flaky.remote(p), timeout=90) == "survived"
+    assert int(open(p).read()) == 3
+
+
+def test_no_retry_when_disabled(rmt_start_cluster):
+    @rmt.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(rmt.WorkerCrashedError):
+        rmt.get(die.remote(), timeout=60)
+
+
+def test_lineage_reconstruction_on_node_death(rmt_start_cluster):
+    rt = rmt_start_cluster
+    big = make.remote(400_000)
+    rmt.get(big, timeout=30)
+    locs = rt.gcs.get_object_locations(big.binary())
+    assert locs
+    rt.remove_node(next(iter(locs)))
+    time.sleep(0.5)
+    val = rmt.get(big, timeout=90)
+    assert float(val.sum()) == 7.0 * 400_000
+
+
+def test_node_affinity(rmt_start_cluster):
+    rt = rmt_start_cluster
+    from ray_memory_management_tpu.utils import NodeAffinitySchedulingStrategy
+
+    target = list(rt.nodes.keys())[1]
+
+    @rmt.remote
+    def here():
+        return os.environ["RMT_NODE_ID"]
+
+    pinned = here.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target)
+    )
+    assert rmt.get(pinned.remote(), timeout=60) == target.hex()
+
+
+def test_spilling_and_restore(rmt_small_store):
+    rt = rmt_small_store
+    refs = [rmt.put(np.full(4_000_000, i, dtype=np.float32))
+            for i in range(8)]
+    store = rt.head_node().store
+    assert store.spilled_count() > 0
+    for i, r in enumerate(refs):
+        v = rmt.get(r)
+        assert v[0] == i
+        del v
+
+
+def test_custom_resources():
+    rt = rmt.init(num_cpus=4, resources={"widget": 2})
+    try:
+        @rmt.remote(resources={"widget": 1}, num_cpus=0)
+        def uses_widget():
+            return "ok"
+
+        assert rmt.get(uses_widget.remote(), timeout=60) == "ok"
+        assert rmt.cluster_resources().get("widget") == 2.0
+    finally:
+        rmt.shutdown()
