@@ -182,7 +182,7 @@ class ActorHandle:
         self._class_name = class_name
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        if name.startswith("__"):  # dunder lookups are never actor methods
             raise AttributeError(name)
         return ActorMethod(self, name)
 
@@ -341,12 +341,16 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 
 def get_actor(name: str) -> ActorHandle:
     rt = _worker_context.get_runtime()
-    if rt is None:
-        raise RmtError("get_actor() is driver-only for now")
-    rec = rt.gcs.get_named_actor(name)
-    if rec is None:
-        raise ValueError(f"no actor named {name!r}")
-    return ActorHandle(rec.actor_id.binary(), rec.spec.name)
+    if rt is not None:
+        rec = rt.gcs.get_named_actor(name)
+        if rec is None:
+            raise ValueError(f"no actor named {name!r}")
+        return ActorHandle(rec.actor_id.binary(), rec.spec.name)
+    proxy = _worker_context.get_proxy()
+    if proxy is None:
+        raise RmtError("not initialized")
+    actor_id = proxy.get_named_actor(name)
+    return ActorHandle(actor_id, name)
 
 
 # -------------------------------------------------------------------- init

@@ -1,0 +1,179 @@
+"""Public collective API.
+
+Mirrors python/ray/util/collective/collective.py — the full surface:
+init_collective_group(:120), create_collective_group(:151), declare/destroy,
+get_rank, get_collective_group_size, allreduce(:258), barrier(:298),
+reduce(:311), broadcast(:373), allgather(:423), reducescatter(:472),
+send(:531)/recv(:594) — with backends re-targeted for TPU (types.py here):
+
+  - ``xla``: collectives compile to XLA ICI programs over a jax mesh
+    (mesh_group.py). Caller must be a process that owns devices (the
+    host-process model); tensors are the stacked [world, ...] representation.
+  - ``objstore``: cross-actor CPU collectives through the object plane with a
+    named-actor rendezvous (coordinator.py), callable from any rank actor.
+
+A GroupManager keyed by group name tracks membership per process, like the
+reference's _group_mgr (collective.py:40).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .types import Backend, ReduceOp
+
+
+class _GroupManager:
+    def __init__(self):
+        self._groups: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def put(self, name: str, group) -> None:
+        with self._lock:
+            self._groups[name] = group
+
+    def get(self, name: str):
+        with self._lock:
+            group = self._groups.get(name)
+        if group is None:
+            raise ValueError(
+                f"collective group {name!r} is not initialized in this "
+                f"process; call init_collective_group() first"
+            )
+        return group
+
+    def pop(self, name: str):
+        with self._lock:
+            return self._groups.pop(name, None)
+
+
+_group_mgr = _GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = Backend.XLA,
+    group_name: str = "default",
+    devices: Optional[list] = None,
+):
+    """Join (rank) this process/actor into a collective group
+    (reference :120)."""
+    backend = Backend.resolve(backend)
+    if backend == Backend.XLA:
+        from .mesh_group import MeshCollectives
+
+        group = MeshCollectives(devices)
+        if world_size != group.world_size:
+            raise ValueError(
+                f"xla backend: world_size {world_size} != "
+                f"{group.world_size} local devices; pass devices= explicitly"
+            )
+        group.rank = rank
+        group.group_name = group_name
+    else:
+        from .coordinator import ObjstoreGroup, create_coordinator
+
+        coord = create_coordinator(group_name, world_size)
+        group = ObjstoreGroup(coord, world_size, rank, group_name)
+    _group_mgr.put(group_name, group)
+    return group
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: List[int],
+    backend: str = Backend.OBJSTORE,
+    group_name: str = "default",
+):
+    """Declarative group over existing actors (reference :151): sends an
+    ``init_collective_group`` call into every actor. Actor classes must expose
+    the conventional ``_rmt_init_collective`` method, or be plain classes —
+    in which case we call the module-level init inside the actor via a
+    closure task."""
+    from .. import api
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must align")
+    backend = Backend.resolve(backend)
+    if backend == Backend.XLA:
+        raise ValueError(
+            "xla groups are per-process meshes; create them inside the actor "
+            "with init_collective_group(backend='xla')"
+        )
+    from .coordinator import create_coordinator
+
+    create_coordinator(group_name, world_size)  # pre-create, avoids races
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor._rmt_init_collective.remote(
+            world_size, rank, backend, group_name
+        ))
+    api.get(refs, timeout=120)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _group_mgr.pop(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group_mgr.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group_mgr.get(group_name).world_size
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _group_mgr.get(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+# ---------------------------------------------------------------- operations
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    return _group_mgr.get(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    return _group_mgr.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group_mgr.get(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group_mgr.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    return _group_mgr.get(group_name).reducescatter(tensor, op)
+
+
+def barrier(group_name: str = "default"):
+    return _group_mgr.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return _group_mgr.get(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group_mgr.get(group_name).recv(src_rank)
+
+
+class CollectiveGroupMixin:
+    """Mixin giving actor classes the conventional init hook used by
+    create_collective_group."""
+
+    def _rmt_init_collective(self, world_size: int, rank: int, backend: str,
+                             group_name: str) -> bool:
+        init_collective_group(world_size, rank, backend, group_name)
+        return True

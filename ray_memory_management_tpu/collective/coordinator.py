@@ -1,0 +1,185 @@
+"""Object-plane collective backend: rendezvous actor + derived collectives.
+
+The CPU fallback (Gloo analog, gloo_collective_group.py) re-architected for
+this runtime: instead of pygloo transports, ranks meet at a named coordinator
+actor — the same named-actor rendezvous the reference uses to share the
+NCCLUniqueID (nccl_collective_group.py:53-95) — and the data itself rides the
+shared-memory object plane (small tensors inline, large ones zero-copy through
+the store).
+
+The coordinator implements one primitive, ``gather(seq, rank, value)``: block
+until all ranks contributed, return the ordered list. Every collective is
+derived client-side (allreduce = gather + local reduce; broadcast = gather +
+pick root; ...). P2P send/recv uses per-destination mailboxes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .types import ReduceOp
+
+
+class _CoordinatorImpl:
+    """Actor class (registered lazily so the decorator binds to the running
+    API). async methods: contributions from different ranks interleave on the
+    actor's asyncio loop (fiber.h-style concurrency)."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self._rounds: Dict[int, List[Any]] = {}
+        self._events: Dict[int, "asyncio.Event"] = {}
+        self._mailboxes: Dict[Tuple[int, int, int], Any] = {}
+        self._mail_events: Dict[Tuple[int, int, int], "asyncio.Event"] = {}
+
+    def _event(self, table, key):
+        import asyncio
+
+        ev = table.get(key)
+        if ev is None:
+            table[key] = ev = asyncio.Event()
+        return ev
+
+    async def gather(self, seq: int, rank: int, value) -> List[Any]:
+        round_ = self._rounds.setdefault(seq, [None] * self.world_size)
+        round_[rank] = (True, value)
+        ev = self._event(self._events, seq)
+        if all(v is not None for v in round_):
+            ev.set()
+        else:
+            await ev.wait()
+        return [v[1] for v in self._rounds[seq]]
+
+    def retire(self, seq: int) -> None:
+        """Drop a completed round (called by rank 0 of the NEXT round so slow
+        readers of round N are never raced)."""
+        self._rounds.pop(seq - self.world_size * 4, None)
+        self._events.pop(seq - self.world_size * 4, None)
+
+    async def put_mail(self, seq: int, src: int, dst: int, value) -> None:
+        key = (seq, src, dst)
+        self._mailboxes[key] = value
+        self._event(self._mail_events, key).set()
+
+    async def take_mail(self, seq: int, src: int, dst: int):
+        key = (seq, src, dst)
+        ev = self._event(self._mail_events, key)
+        await ev.wait()
+        value = self._mailboxes.pop(key)
+        self._mail_events.pop(key, None)
+        return value
+
+
+_NUMPY_REDUCERS = {
+    ReduceOp.SUM: lambda parts: np.sum(parts, axis=0),
+    ReduceOp.PRODUCT: lambda parts: np.prod(parts, axis=0),
+    ReduceOp.MIN: lambda parts: np.min(parts, axis=0),
+    ReduceOp.MAX: lambda parts: np.max(parts, axis=0),
+}
+
+
+def _reduce(values: List[Any], op: str):
+    arrs = [np.asarray(v) for v in values]
+    return _NUMPY_REDUCERS[op](np.stack(arrs))
+
+
+class ObjstoreGroup:
+    """Per-rank handle to an object-plane collective group."""
+
+    def __init__(self, coordinator_handle, world_size: int, rank: int,
+                 group_name: str):
+        self._coord = coordinator_handle
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        # collectives and p2p keep separate sequence spaces: every rank runs
+        # the same ordered list of collectives (SPMD discipline), while p2p
+        # ordering is per (src, dst) pair
+        self._coll_seq = 0
+        self._p2p_seq: Dict[Tuple[int, int], int] = {}
+
+    def _next_coll_seq(self) -> int:
+        self._coll_seq += 1
+        return self._coll_seq
+
+    def _next_p2p_seq(self, src: int, dst: int) -> int:
+        key = (src, dst)
+        self._p2p_seq[key] = self._p2p_seq.get(key, 0) + 1
+        return self._p2p_seq[key]
+
+    def _gather(self, value) -> List[Any]:
+        from .. import api
+
+        seq = self._next_coll_seq()
+        out = api.get(self._coord.gather.remote(seq, self.rank, value),
+                      timeout=120)
+        if self.rank == 0:
+            self._coord.retire.remote(seq)
+        return out
+
+    # -- the collective surface (collective.py:258-615 in the reference) ------
+    def allreduce(self, tensor, op: str = ReduceOp.SUM):
+        return _reduce(self._gather(np.asarray(tensor)), op)
+
+    def reduce(self, tensor, root_rank: int = 0, op: str = ReduceOp.SUM):
+        values = self._gather(np.asarray(tensor))
+        if self.rank == root_rank:
+            return _reduce(values, op)
+        return np.asarray(tensor)
+
+    def broadcast(self, tensor, root_rank: int = 0):
+        values = self._gather(
+            np.asarray(tensor) if self.rank == root_rank else None
+        )
+        return np.asarray(values[root_rank])
+
+    def allgather(self, tensor) -> List[Any]:
+        return [np.asarray(v) for v in self._gather(np.asarray(tensor))]
+
+    def reducescatter(self, tensor, op: str = ReduceOp.SUM):
+        reduced = _reduce(self._gather(np.asarray(tensor)), op)
+        chunks = np.array_split(reduced, self.world_size, axis=0)
+        return chunks[self.rank]
+
+    def barrier(self):
+        self._gather(None)
+
+    def send(self, tensor, dst_rank: int):
+        from .. import api
+
+        seq = self._next_p2p_seq(self.rank, dst_rank)
+        api.get(self._coord.put_mail.remote(
+            seq, self.rank, dst_rank, np.asarray(tensor)), timeout=120)
+
+    def recv(self, src_rank: int):
+        from .. import api
+
+        seq = self._next_p2p_seq(src_rank, self.rank)
+        return np.asarray(api.get(
+            self._coord.take_mail.remote(seq, src_rank, self.rank),
+            timeout=120,
+        ))
+
+
+def create_coordinator(group_name: str, world_size: int):
+    """Create (or fetch) the named coordinator actor for a group; racing
+    creators fall back to lookup (the reference's rank-0-creates /
+    others-poll rendezvous, nccl_collective_group.py:53-95)."""
+    from .. import api
+
+    name = f"__rmt_collective_{group_name}"
+    try:
+        return api.get_actor(name)
+    except ValueError:
+        pass
+    actor_cls = api.remote(_CoordinatorImpl)
+    try:
+        return actor_cls.options(
+            name=name, max_concurrency=max(world_size * 2, 8)
+        ).remote(world_size)
+    except ValueError:
+        return api.get_actor(name)  # lost the creation race

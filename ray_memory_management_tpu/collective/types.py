@@ -1,0 +1,73 @@
+"""Collective types: backends and reduce ops.
+
+Mirrors python/ray/util/collective/types.py:29-34 — the reference enumerates
+NCCL and GLOO; the TPU build replaces them with:
+  - XLA: collectives lowered to XLA ICI programs over a jax device mesh
+    (psum / all_gather / psum_scatter / ppermute), the NCCL analog;
+  - OBJSTORE: a CPU fallback riding the shared-memory object plane with an
+    actor-based rendezvous (the Gloo analog; the rendezvous-via-named-actor
+    pattern follows nccl_collective_group.py:53-95).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class Backend:
+    XLA = "xla"
+    OBJSTORE = "objstore"
+    # Accept the reference's names as aliases so ported user code maps cleanly.
+    _ALIASES = {"nccl": XLA, "gloo": OBJSTORE, "xla": XLA, "objstore": OBJSTORE}
+
+    @classmethod
+    def resolve(cls, name: str) -> str:
+        try:
+            return cls._ALIASES[name.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown collective backend {name!r}; "
+                f"use one of {sorted(set(cls._ALIASES.values()))}"
+            )
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: str = ReduceOp.SUM
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: str = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: str = ReduceOp.SUM
+    timeout_ms: int = 30_000

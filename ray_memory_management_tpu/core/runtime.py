@@ -1153,6 +1153,11 @@ class Runtime:
                 with self._lock:
                     info = self.actors.get(msg["actor_id"])
                 reply["exists"] = info is not None
+            elif mtype == "get_named_actor":
+                rec = self.gcs.get_named_actor(msg["name"])
+                if rec is None:
+                    raise ValueError(f"no actor named {msg['name']!r}")
+                reply["actor_id"] = rec.actor_id.binary()
             else:
                 raise ValueError(f"unknown worker request {mtype}")
         except Exception as e:  # noqa: BLE001

@@ -170,6 +170,10 @@ class WorkerRuntimeProxy:
         reply = self._request({"type": "actor_info", "actor_id": actor_id})
         return reply
 
+    def get_named_actor(self, name: str) -> bytes:
+        reply = self._request({"type": "get_named_actor", "name": name})
+        return reply["actor_id"]
+
 
 class _ActorState:
     def __init__(self, instance, max_concurrency: int):
