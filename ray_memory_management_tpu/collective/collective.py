@@ -89,10 +89,11 @@ def create_collective_group(
     group_name: str = "default",
 ):
     """Declarative group over existing actors (reference :151): sends an
-    ``init_collective_group`` call into every actor. Actor classes must expose
-    the conventional ``_rmt_init_collective`` method, or be plain classes —
-    in which case we call the module-level init inside the actor via a
-    closure task."""
+    ``init_collective_group`` call into every actor. Actor classes must
+    provide the ``_rmt_init_collective`` hook — inherit
+    :class:`CollectiveGroupMixin` (or define an equivalent method that calls
+    ``init_collective_group`` locally). An actor without the hook fails with
+    a remote AttributeError naming the missing method."""
     from .. import api
 
     if len(actors) != len(ranks):
@@ -115,7 +116,16 @@ def create_collective_group(
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    _group_mgr.pop(group_name)
+    """Drop the local group and kill the rendezvous coordinator (if this
+    process can reach it) so re-forming the group starts from clean state."""
+    group = _group_mgr.pop(group_name)
+    if group is not None and hasattr(group, "_coord"):
+        from .coordinator import destroy_coordinator
+
+        try:
+            destroy_coordinator(group_name)
+        except Exception:
+            pass  # driver gone / already dead
 
 
 def get_rank(group_name: str = "default") -> int:

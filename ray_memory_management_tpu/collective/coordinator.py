@@ -28,13 +28,14 @@ class _CoordinatorImpl:
     actor's asyncio loop (fiber.h-style concurrency)."""
 
     def __init__(self, world_size: int):
-        import asyncio
-
         self.world_size = world_size
         self._rounds: Dict[int, List[Any]] = {}
         self._events: Dict[int, "asyncio.Event"] = {}
         self._mailboxes: Dict[Tuple[int, int, int], Any] = {}
         self._mail_events: Dict[Tuple[int, int, int], "asyncio.Event"] = {}
+
+    def world(self) -> int:
+        return self.world_size
 
     def _event(self, table, key):
         import asyncio
@@ -168,18 +169,45 @@ class ObjstoreGroup:
 def create_coordinator(group_name: str, world_size: int):
     """Create (or fetch) the named coordinator actor for a group; racing
     creators fall back to lookup (the reference's rank-0-creates /
-    others-poll rendezvous, nccl_collective_group.py:53-95)."""
+    others-poll rendezvous, nccl_collective_group.py:53-95). A coordinator
+    left over from a same-named group must match world_size — call
+    destroy_collective_group() first to re-form a group with a different
+    world (the reference has the same reuse rule for named NCCL groups)."""
     from .. import api
 
     name = f"__rmt_collective_{group_name}"
+
+    def checked(handle):
+        existing = api.get(handle.world.remote(), timeout=60)
+        if existing != world_size:
+            raise ValueError(
+                f"collective group {group_name!r} already exists with "
+                f"world_size={existing} (wanted {world_size}); call "
+                f"destroy_collective_group({group_name!r}) first"
+            )
+        return handle
+
     try:
-        return api.get_actor(name)
-    except ValueError:
-        pass
+        return checked(api.get_actor(name))
+    except ValueError as e:
+        if "world_size" in str(e):
+            raise
     actor_cls = api.remote(_CoordinatorImpl)
     try:
         return actor_cls.options(
             name=name, max_concurrency=max(world_size * 2, 8)
         ).remote(world_size)
     except ValueError:
-        return api.get_actor(name)  # lost the creation race
+        return checked(api.get_actor(name))  # lost the creation race
+
+
+def destroy_coordinator(group_name: str) -> None:
+    """Kill the named coordinator so the next group formation starts fresh
+    (prevents stale rounds from leaking across re-inits)."""
+    from .. import api
+
+    try:
+        handle = api.get_actor(f"__rmt_collective_{group_name}")
+    except ValueError:
+        return
+    api.kill(handle)

@@ -16,7 +16,6 @@ driver's ``dryrun_multichip`` exercises it on a virtual mesh).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -30,13 +29,16 @@ _AXIS = "ranks"
 
 
 def _reduce_fn(op: str):
+    def _product(t):
+        # gather-then-multiply: exact for zeros/negatives/ints (an exp-of-
+        # psum-of-logs trick would NaN on non-positive inputs)
+        return jnp.prod(lax.all_gather(t, _AXIS, axis=0), axis=0)
+
     return {
         ReduceOp.SUM: lambda t: lax.psum(t, _AXIS),
         ReduceOp.MAX: lambda t: lax.pmax(t, _AXIS),
         ReduceOp.MIN: lambda t: lax.pmin(t, _AXIS),
-        ReduceOp.PRODUCT: lambda t: jnp.exp(
-            lax.psum(jnp.log(t.astype(jnp.float32)), _AXIS)
-        ),
+        ReduceOp.PRODUCT: _product,
     }[op]
 
 
@@ -48,6 +50,16 @@ class MeshCollectives:
         self.mesh = Mesh(devices, (_AXIS,))
         self.world_size = len(devices)
         self._sharding = NamedSharding(self.mesh, P(_AXIS))
+        # per-instance program cache (an lru_cache on methods would pin the
+        # instance and its compiled executables in a class-level cache
+        # forever); dies with the group
+        self._programs = {}
+
+    def _cached(self, key, build):
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = self._programs[key] = build()
+        return fn
 
     # -- helpers --------------------------------------------------------------
     def shard_ranks(self, stacked):
@@ -64,17 +76,21 @@ class MeshCollectives:
         )
 
     # -- collectives (each returns a jitted, cached program) ------------------
-    @functools.lru_cache(maxsize=256)
     def _allreduce_fn(self, op: str):
-        red = _reduce_fn(op)
-        return jax.jit(self._smap(lambda t: red(t)))
+        return self._cached(
+            ("allreduce", op),
+            lambda: jax.jit(self._smap(_reduce_fn(op))),
+        )
 
     def allreduce(self, stacked, op: str = ReduceOp.SUM):
         """[world, ...] -> [world, ...] with every rank-slice = reduction."""
         return self._allreduce_fn(op)(self.shard_ranks(stacked))
 
-    @functools.lru_cache(maxsize=256)
     def _reducescatter_fn(self, op: str):
+        return self._cached(("reducescatter", op),
+                            lambda: self._build_reducescatter(op))
+
+    def _build_reducescatter(self, op: str):
         if op != ReduceOp.SUM:
             red = _reduce_fn(op)
 
@@ -94,13 +110,12 @@ class MeshCollectives:
         """[world, world*n] -> rank i holds sum-slice i ([world, n] global)."""
         return self._reducescatter_fn(op)(self.shard_ranks(stacked))
 
-    @functools.lru_cache(maxsize=256)
     def _allgather_fn(self):
         # out_spec P(): every rank computes the identical full stack, so the
         # global result is the replicated [world, ...] gather
-        return jax.jit(self._smap(
+        return self._cached(("allgather",), lambda: jax.jit(self._smap(
             lambda t: lax.all_gather(t[0], _AXIS, axis=0), out_spec=P()
-        ))
+        )))
 
     def allgather(self, stacked):
         """[world, ...] -> every rank holds the full stack (returned global
@@ -108,8 +123,11 @@ class MeshCollectives:
         out = self._allgather_fn()(self.shard_ranks(stacked))
         return out
 
-    @functools.lru_cache(maxsize=256)
     def _broadcast_fn(self, root: int):
+        return self._cached(("broadcast", root),
+                            lambda: self._build_broadcast(root))
+
+    def _build_broadcast(self, root: int):
         def body(t):
             # every rank takes root's slice: a collective-permute from root
             full = lax.all_gather(t[0], _AXIS, axis=0)
@@ -120,8 +138,11 @@ class MeshCollectives:
     def broadcast(self, stacked, root: int = 0):
         return self._broadcast_fn(root)(self.shard_ranks(stacked))
 
-    @functools.lru_cache(maxsize=256)
     def _ppermute_fn(self, perm: tuple):
+        return self._cached(("ppermute", perm),
+                            lambda: self._build_ppermute(perm))
+
+    def _build_ppermute(self, perm: tuple):
         def body(t):
             return lax.ppermute(t, _AXIS, perm=list(perm))
 

@@ -1,0 +1,202 @@
+"""TransformerLM: the flagship decoder-only language model (GPT/Llama family).
+
+Net-new versus the reference (its model zoo lives in torch userland; SURVEY.md
+§2.4-2.5): this is a TPU-first implementation —
+
+  - params are plain pytrees with LAYER-STACKED weights ([L, ...]) consumed by
+    ``lax.scan``, so compile time is O(1) in depth and XLA pipelines the
+    layer loop;
+  - compute in bf16 (MXU), params and reductions in fp32;
+  - attention is pluggable: "flash" (Pallas kernel, ops/flash_attention.py),
+    "ref" (jnp), "ring"/"ulysses" (sequence parallel, ops/ring_attention.py);
+  - the architecture knobs cover GPT-2 (LayerNorm+GELU, learned positions
+    approximated by RoPE here) and Llama (RMSNorm+SwiGLU+RoPE+GQA) presets.
+
+Sharding rules for these parameter names live in parallel/sharding.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # < n_heads => GQA
+    d_ff: Optional[int] = None        # default: SwiGLU 8/3 * d_model
+    max_seq: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16         # activation/compute dtype (MXU)
+    param_dtype: Any = jnp.float32
+    attention: str = "auto"           # auto|flash|ref|ring|ulysses
+    remat: bool = False               # jax.checkpoint each block
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        # SwiGLU sizing, rounded to 128 for MXU tiling
+        d = int(self.d_model * 8 / 3)
+        return (d + 127) // 128 * 128
+
+
+# presets (sizes match the commonly-published configs)
+PRESETS: Dict[str, TransformerConfig] = {
+    "test": TransformerConfig(vocab_size=512, d_model=64, n_layers=2,
+                              n_heads=4, max_seq=128),
+    "gpt2-small": TransformerConfig(vocab_size=50_304, d_model=768,
+                                    n_layers=12, n_heads=12, max_seq=1024),
+    "gpt2-medium": TransformerConfig(vocab_size=50_304, d_model=1024,
+                                     n_layers=24, n_heads=16, max_seq=1024),
+    "llama-1b": TransformerConfig(vocab_size=32_000, d_model=2048,
+                                  n_layers=16, n_heads=32, n_kv_heads=8,
+                                  max_seq=2048),
+    "llama-7b": TransformerConfig(vocab_size=32_000, d_model=4096,
+                                  n_layers=32, n_heads=32, max_seq=2048),
+}
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Layer-stacked parameter pytree."""
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.ff_dim
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    keys = jax.random.split(key, 8)
+    pd = cfg.param_dtype
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5))
+
+    return {
+        "tok_embed": dense(keys[0], (cfg.vocab_size, D), D),
+        "layers": {
+            "ln1": jnp.ones((L, D), pd),
+            "ln2": jnp.ones((L, D), pd),
+            "wq": dense(keys[1], (L, D, H * Dh), D),
+            "wk": dense(keys[2], (L, D, Hkv * Dh), D),
+            "wv": dense(keys[3], (L, D, Hkv * Dh), D),
+            "wo": dense(keys[4], (L, H * Dh, D), H * Dh),
+            "w1": dense(keys[5], (L, D, F), D),
+            "w3": dense(keys[6], (L, D, F), D),
+            "w2": dense(keys[7], (L, F, D), F),
+        },
+        "final_ln": jnp.ones((D,), pd),
+        "lm_head": dense(keys[0], (D, cfg.vocab_size), D),
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embeddings over [..., S, H, Dh]."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., S, half]
+    cos = jnp.cos(angles)[..., None, :].astype(x.dtype)  # [..., S, 1, half]
+    sin = jnp.sin(angles)[..., None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh, sp_axis):
+    """Dispatch on the configured attention implementation. q/k/v are
+    [B, H, S, Dh] (kv possibly fewer heads — repeated here for GQA)."""
+    if cfg.kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    mode = cfg.attention
+    if mode in ("ring", "ulysses"):
+        from ..ops import ring_attention, ulysses_attention
+
+        fn = ring_attention if mode == "ring" else ulysses_attention
+        return fn(q, k, v, mesh, axis=sp_axis or "sp", causal=True)
+    from ..ops import flash_attention, reference_attention
+
+    if mode == "ref":
+        return reference_attention(q, k, v, causal=True)
+    use = None if mode == "auto" else "on"
+    return flash_attention(q, k, v, causal=True, use_pallas=use)
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
+    """tokens [B, S] -> logits [B, S, V] (fp32)."""
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(S)[None, :]
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+
+    def block(x, layer):
+        h = _rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, Dh)
+        k = (h @ layer["wk"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
+        v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
+        q = _rope(q, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+        k = _rope(k, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        o = _attention(q, k, v, cfg, mesh, sp_axis)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+        x = x + o @ layer["wo"].astype(cfg.dtype)
+        h = _rmsnorm(x, layer["ln2"])
+        gate = jax.nn.silu(h @ layer["w1"].astype(cfg.dtype))
+        up = h @ layer["w3"].astype(cfg.dtype)
+        x = x + (gate * up) @ layer["w2"].astype(cfg.dtype)
+        return x
+
+    block_fn = jax.checkpoint(block) if cfg.remat else block
+
+    def scan_body(x, layer):
+        return block_fn(x, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = _rmsnorm(x, params["final_ln"])
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, mesh=None, sp_axis=None):
+    """batch: {"tokens": [B, S], "targets": [B, S]} -> mean xent."""
+    logits = forward(params, batch["tokens"], cfg, mesh, sp_axis)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    take = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)
+    return -jnp.mean(take)
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def generate(params, cfg: TransformerConfig, prompt, steps: int,
+             temperature: float = 0.0, key=None):
+    """Greedy/sampled decoding by full-prefix recompute (a KV-cached decode
+    path is a serving-layer optimization, later round). prompt: [B, S0]."""
+    tokens = prompt
+    for _ in range(steps):
+        logits = forward(params, tokens, cfg)[:, -1]
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens

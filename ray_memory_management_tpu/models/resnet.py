@@ -1,0 +1,119 @@
+"""ResNet-50 (flax.linen) — the vision benchmark model.
+
+The reference's ResNet-50 story is "torchvision inside the user's Train loop"
+(BASELINE.json: ResNet-50 DDP images/sec target). Here it is a first-class
+jax model: bf16 conv compute (MXU), fp32 BatchNorm statistics, NHWC layout
+(TPU-native), trained data-parallel via parallel/sharding.py presets.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.features, (3, 3), self.strides)(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), self.strides,
+                            name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(64 * 2 ** i, strides, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000) -> ResNet:
+    return ResNet((3, 4, 6, 3), num_classes)
+
+
+def resnet18_like(num_classes: int = 10) -> ResNet:
+    """Small variant for tests."""
+    return ResNet((1, 1, 1, 1), num_classes)
+
+
+def init_resnet(model: ResNet, key, image_shape=(224, 224, 3)):
+    variables = model.init(key, jnp.zeros((1, *image_shape), jnp.float32),
+                           train=False)
+    return variables["params"], variables["batch_stats"]
+
+
+def resnet_loss_fn(model: ResNet, params, batch_stats, batch):
+    """Cross-entropy over {"image": [B,H,W,C], "label": [B]}; returns
+    (loss, new_batch_stats) — BatchNorm stats thread through as mutable
+    state, the flax idiom."""
+    logits, updates = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        batch["image"], train=True, mutable=["batch_stats"],
+    )
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(
+        jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)
+    )
+    return loss, updates["batch_stats"]
+
+
+def make_resnet_train_step(model: ResNet, optimizer, mesh=None):
+    """DP train step; with a mesh, the batch shards over data axes and XLA
+    cross-replica-sums BatchNorm grads like any other grad (per-shard BN
+    statistics — the standard/fast choice, matching torch DDP defaults)."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharding import batch_pspec
+
+    def step(params, batch_stats, opt_state, batch):
+        if mesh is not None:
+            batch = jax.lax.with_sharding_constraint(
+                batch, NamedSharding(mesh, batch_pspec(mesh))
+            )
+        (loss, new_stats), grads = jax.value_and_grad(
+            lambda p: resnet_loss_fn(model, p, batch_stats, batch),
+            has_aux=True,
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, new_stats, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))

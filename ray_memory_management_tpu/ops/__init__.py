@@ -1,0 +1,4 @@
+"""TPU ops: Pallas kernels and sequence-parallel attention."""
+
+from .flash_attention import flash_attention, reference_attention  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

@@ -1,0 +1,187 @@
+"""Flash attention as a Pallas TPU kernel, with a pure-jnp fallback.
+
+Net-new versus the reference (SURVEY.md §2.4: the reference has NO attention
+kernels — GPU attention lives inside user torch code). Here the hot op is a
+first-class TPU kernel:
+
+  - forward: online-softmax blockwise attention; Q blocks ride the grid, K/V
+    stream through VMEM with a fori_loop; accumulators stay in fp32 while
+    inputs can be bf16 (MXU-friendly).
+  - backward: recompute-based custom VJP using the jnp reference (correct and
+    memory-lean; a fused Pallas backward is a later-round optimization).
+  - CPU/testing: the same kernel runs under interpret mode; tests compare it
+    against the jnp reference on a virtual device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _pick_block(n: int, target: int) -> int:
+    """Largest divisor of n that is <= target (TPU wants aligned blocks; for
+    odd sizes we fall back to the full dimension)."""
+    b = min(n, target)
+    while n % b:
+        b -= 1
+    return b
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """Plain jnp attention (the correctness oracle)."""
+    *_, S, D = q.shape
+    Skv = k.shape[-2]
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(S)[:, None] + (Skv - S)
+        ki = jnp.arange(Skv)[None, :]
+        s = jnp.where(ki <= qi, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, scale: float,
+                block_q: int, block_k: int, kv_len: int):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    qi = pl.program_id(1)
+    n_kb = kv_len // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    D = q.shape[-1]
+    init = (
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+        jnp.zeros((block_q, D), jnp.float32),
+    )
+    m, l, acc = lax.fori_loop(0, n_kb, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
+               block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+    Skv = k.shape[1]
+    block_q = _pick_block(S, block_q)
+    block_k = _pick_block(Skv, block_k)
+    grid = (BH, S // block_q)
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, kv_len=Skv,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skv, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skv, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _on_tpu() -> bool:
+    """Is default computation placed on TPU? jax_default_device (set by CPU
+    test harnesses) wins over the default backend, because compiled Pallas
+    only lowers on the TPU backend."""
+    try:
+        dd = jax.config.jax_default_device
+        if dd is not None:
+            return dd.platform == "tpu"
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, scale, use_pallas):
+    if use_pallas == "off":
+        return reference_attention(q, k, v, causal, scale)
+    return _flash_fwd(q, k, v, causal, scale, block_q=256, block_k=256,
+                      interpret=(use_pallas == "interpret"))
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, use_pallas):
+    out = _flash_attention(q, k, v, causal, scale, use_pallas)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, use_pallas, residuals, g):
+    # Recompute-based backward: differentiate the jnp reference (the
+    # rematerialization trades FLOPs for HBM, the right TPU default)
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    use_pallas: Optional[str] = None):
+    """Multi-head attention over [B, H, S, D] (or [BH, S, D]) inputs.
+
+    ``use_pallas``: "on" (compiled kernel), "interpret" (kernel under the
+    Pallas interpreter — CPU testing), "off" (jnp reference), or None =
+    auto: "on" when running on TPU, "off" elsewhere (interpret mode is too
+    slow to be a default).
+    """
+    if use_pallas is None:
+        use_pallas = "on" if _on_tpu() else "off"
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    squeeze = q.ndim == 4
+    if squeeze:
+        B, H, S, D = q.shape
+        qf = q.reshape(B * H, S, D)
+        kf = k.reshape(B * H, k.shape[-2], D)
+        vf = v.reshape(B * H, v.shape[-2], D)
+    else:
+        qf, kf, vf = q, k, v
+    out = _flash_attention(qf, kf, vf, causal, scale, use_pallas)
+    return out.reshape(q.shape) if squeeze else out

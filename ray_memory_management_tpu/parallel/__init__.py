@@ -1,0 +1,9 @@
+"""Parallelism strategy library: meshes + sharding presets (DP/FSDP/TP/SP)."""
+
+from .mesh import cpu_mesh, local_tpu_mesh, make_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_pspec,
+    make_train_step,
+    param_pspecs,
+    shard_pytree,
+)

@@ -1,0 +1,133 @@
+"""Sharding presets: DP / FSDP / TP(+combinations) as PartitionSpec rules.
+
+This module is the TPU replacement for the reference's parallelism wiring
+(SURVEY.md §2.4: DDP via torch process groups, FSDP via user code, TP absent):
+strategies are *sharding rules over a named mesh*, applied with pjit/jit so
+XLA inserts the collectives (psum for DP grads, all-gather/reduce-scatter for
+FSDP, all-reduce pairs for Megatron TP) on ICI.
+
+Rules are keyed by the TransformerLM parameter names (models/gpt.py); unknown
+trees fall back to dimension-based heuristics so other models (ResNet) work
+too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Megatron-style TP rules for TransformerLM stacked params [L, in, out]:
+# column-parallel (shard output dim), row-parallel (shard input dim).
+_TP_RULES = {
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "w1": P(None, None, "tp"),
+    "w3": P(None, None, "tp"),
+    "w2": P(None, "tp", None),
+    "ln1": P(None, None),
+    "ln2": P(None, None),
+    "tok_embed": P("tp", None),   # vocab-parallel embedding
+    "lm_head": P(None, "tp"),
+    "final_ln": P(None),
+}
+
+
+def _maybe_add_fsdp(spec: P, shape, fsdp_size: int) -> P:
+    """Layer FSDP onto a TP spec: shard the largest still-unsharded,
+    divisible dimension along the fsdp axis (ZeRO-3-style parameter
+    sharding; XLA all-gathers just-in-time and reduce-scatters grads)."""
+    dims = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = sorted(
+        range(len(shape)), key=lambda i: -int(np.prod(shape[i:i + 1]))
+    )
+    for i in candidates:
+        if dims[i] is None and shape[i] % fsdp_size == 0 and shape[i] > 1:
+            dims[i] = "fsdp"
+            return P(*dims)
+    return P(*dims)
+
+
+def param_pspecs(params: Dict[str, Any], mesh: Mesh,
+                 strategy: str = "dp") -> Dict[str, Any]:
+    """PartitionSpec pytree for a parameter pytree.
+
+    strategy: "dp" (replicated params), "fsdp", "tp", "fsdp+tp" / "dp+tp".
+    Mesh must carry the matching axis names.
+    """
+    use_tp = "tp" in strategy and "tp" in mesh.shape
+    use_fsdp = "fsdp" in strategy and "fsdp" in mesh.shape
+    fsdp_size = mesh.shape.get("fsdp", 1)
+
+    def spec_for(path: str, leaf) -> P:
+        shape = leaf.shape
+        spec = P(*([None] * len(shape)))
+        if use_tp:
+            name = path.split("/")[-1]
+            if name in _TP_RULES:
+                spec = _TP_RULES[name]
+                if len(spec) < len(shape):  # non-stacked variant
+                    spec = P(*list(spec)[-len(shape):])
+                elif len(spec) > len(shape):
+                    spec = P(*list(spec)[-len(shape):])
+        if use_fsdp:
+            spec = _maybe_add_fsdp(spec, shape, fsdp_size)
+        return spec
+
+    def walk(tree, path=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, f"{path}/{k}") for k, v in tree.items()}
+        return spec_for(path, tree)
+
+    return walk(params)
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """Shard the batch dimension over every data-ish axis present."""
+    axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    return P(axes if axes else None)
+
+
+def shard_pytree(tree, mesh: Mesh, specs, copy: bool = False) -> Any:
+    """Place a pytree onto the mesh per its specs (used at init; jit
+    propagates from there).
+
+    NOTE: device_put may alias the input's buffers when a shard already
+    lives on the right device, so a later DONATING train step can delete the
+    caller's original tree too. Pass ``copy=True`` if you intend to reuse
+    the unsharded tree afterwards (e.g. sharding the same init across
+    several meshes in tests)."""
+    import numpy as np  # local: forces a host-side copy when requested
+
+    def put(x, s):
+        if copy:
+            x = np.array(x)
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(
+        put, tree, specs, is_leaf=lambda x: not isinstance(x, dict),
+    )
+
+
+def make_train_step(loss_fn, optimizer, mesh: Mesh,
+                    donate: bool = True):
+    """Build the jitted train step. Params/opt-state shardings propagate from
+    their placement (shard_pytree at init); the batch is constrained inside so
+    XLA partitions the whole step and inserts grad psums automatically."""
+    bspec = batch_pspec(mesh)
+
+    def step(params, opt_state, batch):
+        batch = jax.lax.with_sharding_constraint(
+            batch, NamedSharding(mesh, bspec)
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)

@@ -18,6 +18,17 @@ if "host_platform_device_count" not in prev:
 
 import pytest  # noqa: E402
 
+# This image pre-imports jax at interpreter startup (axon TPU platform), so
+# JAX_PLATFORMS set above may be too late to change the default platform.
+# The CPU backend still initializes lazily with the forced 8-device count;
+# pin the default device to CPU so un-meshed ops don't land on the TPU.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except Exception:
+    pass
+
 import ray_memory_management_tpu as rmt  # noqa: E402
 
 

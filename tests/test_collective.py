@@ -158,3 +158,24 @@ def test_objstore_sendrecv(rank_actors):
 def test_objstore_barrier(rank_actors):
     assert all(rmt.get([a.do_barrier.remote() for a in rank_actors],
                        timeout=120))
+
+
+def test_objstore_reducescatter(rank_actors):
+    world = len(rank_actors)
+    outs = rmt.get([a.do_reducescatter.remote(0.0) for a in rank_actors],
+                   timeout=120)
+    total = np.stack([np.arange(world * 2, dtype=np.float32)] * world).sum(0)
+    chunks = np.array_split(total, world, axis=0)
+    for rank, out in enumerate(outs):
+        np.testing.assert_allclose(out, chunks[rank])
+
+
+def test_mesh_allreduce_product_with_zeros_and_negatives(mesh_group):
+    w = mesh_group.world_size
+    stacked = np.stack(
+        [np.array([i - 2.0, 1.0, 0.0], np.float32) for i in range(w)]
+    )
+    out = np.asarray(mesh_group.allreduce(stacked, col.ReduceOp.PRODUCT))
+    expect = stacked.prod(axis=0)
+    for r in range(w):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-5)

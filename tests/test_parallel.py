@@ -1,0 +1,106 @@
+"""Parallelism tests: DP/FSDP/TP/ring-SP training on the virtual 8-CPU mesh,
+plus the graft entry points the driver compile-checks."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_memory_management_tpu.models import gpt
+from ray_memory_management_tpu.parallel import (
+    cpu_mesh,
+    make_train_step,
+    param_pspecs,
+    shard_pytree,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gpt.PRESETS["test"]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+    return cfg, params, batch
+
+
+STRATEGIES = [
+    ("dp", {"dp": 8}),
+    ("fsdp", {"fsdp": 8}),
+    ("tp", {"tp": 4}),
+    ("fsdp+tp", {"fsdp": 2, "tp": 4}),
+]
+
+
+@pytest.mark.parametrize("strategy,axes", STRATEGIES)
+def test_strategy_trains(setup, strategy, axes):
+    cfg, params, batch = setup
+    mesh = cpu_mesh(axes)
+    specs = param_pspecs(params, mesh, strategy)
+    sp = shard_pytree(params, mesh, specs, copy=True)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(sp)
+    step = make_train_step(lambda p, b: gpt.loss_fn(p, b, cfg), opt, mesh)
+    losses = []
+    p, s = sp, opt_state
+    for _ in range(4):
+        p, s, loss = step(p, s, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"{strategy}: {losses}"
+
+
+def test_strategies_agree(setup):
+    """One step of dp and tp must produce (numerically) the same loss."""
+    cfg, params, batch = setup
+    results = {}
+    for strategy, axes in [("dp", {"dp": 8}), ("tp", {"tp": 4})]:
+        mesh = cpu_mesh(axes)
+        specs = param_pspecs(params, mesh, strategy)
+        sp = shard_pytree(params, mesh, specs, copy=True)
+        opt = optax.adam(1e-3)
+        step = make_train_step(lambda p, b: gpt.loss_fn(p, b, cfg), opt,
+                               mesh)
+        _, _, loss = step(sp, opt.init(sp), batch)
+        results[strategy] = float(loss)
+    assert abs(results["dp"] - results["tp"]) < 5e-2, results
+
+
+def test_tp_param_sharding_applied(setup):
+    cfg, params, batch = setup
+    mesh = cpu_mesh({"tp": 4})
+    specs = param_pspecs(params, mesh, "tp")
+    sp = shard_pytree(params, mesh, specs, copy=True)
+    # column-parallel wq: output dim sharded 4-ways
+    shard_shape = sp["layers"]["wq"].sharding.shard_shape(
+        sp["layers"]["wq"].shape
+    )
+    assert shard_shape[-1] == sp["layers"]["wq"].shape[-1] // 4
+
+
+def test_ring_attention_training(setup):
+    """Sequence-parallel (ring attention) end-to-end gradient step."""
+    cfg, params, batch = setup
+    mesh = cpu_mesh({"sp": 8})
+    cfg_sp = dataclasses.replace(cfg, attention="ring")
+    loss = gpt.loss_fn(params, batch, cfg_sp, mesh=mesh, sp_axis="sp")
+    ref = gpt.loss_fn(params, batch, dataclasses.replace(cfg, attention="ref"))
+    assert abs(float(loss) - float(ref)) < 5e-2, (float(loss), float(ref))
+
+
+def test_graft_entry_points():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft", "/root/repo/__graft_entry__.py"
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    fn, args = m.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+    m.dryrun_multichip(8)
