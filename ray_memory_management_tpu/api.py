@@ -85,9 +85,7 @@ class RemoteFunction:
     def _blob(self) -> bytes:
         with self._blob_lock:
             if self._fn_blob is None:
-                import cloudpickle
-
-                self._fn_blob = cloudpickle.dumps(self._fn)
+                self._fn_blob = ser.dumps_function(self._fn)
             return self._fn_blob
 
     def remote(self, *args, **kwargs):
@@ -216,9 +214,7 @@ class ActorClass:
     def _blob(self) -> bytes:
         with self._blob_lock:
             if self._cls_blob is None:
-                import cloudpickle
-
-                self._cls_blob = cloudpickle.dumps(self._cls)
+                self._cls_blob = ser.dumps_function(self._cls)
             return self._cls_blob
 
     def remote(self, *args, **kwargs) -> ActorHandle:

@@ -194,6 +194,42 @@ def deserialize(data: memoryview | bytes, on_release=None) -> Any:
         raise
 
 
+def dumps_function(fn) -> bytes:
+    """cloudpickle a callable so it unpickles in workers that cannot import
+    its defining module (pytest test modules, scripts run by path...). The
+    module is temporarily registered for by-value pickling unless it is this
+    package or an installed library (those import fine remotely). Mirrors the
+    reference's function-export-by-value behavior (its function manager ships
+    code through GCS rather than by module path)."""
+    import inspect
+    import sysconfig
+
+    import cloudpickle
+
+    mod = inspect.getmodule(fn)
+    registered = False
+    if (
+        mod is not None
+        and getattr(mod, "__file__", None)
+        and mod.__name__ != "__main__"
+        and not mod.__name__.startswith("ray_memory_management_tpu")
+    ):
+        site = sysconfig.get_paths()["purelib"]
+        std = sysconfig.get_paths()["stdlib"]
+        f = mod.__file__
+        if not f.startswith(site) and not f.startswith(std):
+            try:
+                cloudpickle.register_pickle_by_value(mod)
+                registered = True
+            except Exception:
+                pass
+    try:
+        return cloudpickle.dumps(fn)
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(mod)
+
+
 def dumps(value: Any) -> bytes:
     return serialize(value).to_bytes()
 

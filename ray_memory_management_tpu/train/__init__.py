@@ -1,0 +1,13 @@
+"""Train library: distributed training over worker-group actors (Ray Train
+analog, jax/TPU-native)."""
+
+from . import session  # noqa: F401
+from .backend_executor import BackendExecutor, TrainingFailedError  # noqa: F401
+from .checkpoint import Checkpoint  # noqa: F401
+from .trainer import (  # noqa: F401
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)

@@ -1,0 +1,232 @@
+"""BackendExecutor + WorkerGroup: driver-side machinery behind a Trainer.
+
+Mirrors the reference's train/_internal/backend_executor.py:42 and
+worker_group.py:91 — create a placement group for the gang (:137), start one
+actor per worker (:178,335), run the backend's on_start hook (:127) (here:
+objstore collective-group formation — the jax.distributed /
+_setup_torch_process_group analog, train/torch/config.py:54), ship the user
+loop (:275,356-360), and drain per-worker result queues
+(train/_internal/session.py:144 → get_next_results, backend_executor.py:362).
+
+TPU mapping: each TrainWorker is a host-process actor; ``chips_per_worker``
+TPU chips are leased to it (TPU_VISIBLE_CHIPS), and inside the loop the user
+builds meshes over the worker's local chips with parallel.make_mesh. Data
+parallelism ACROSS workers rides the collective group exposed via
+``session_collective_group_name``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..exceptions import ActorError, RmtError, TaskError, WorkerCrashedError
+from .checkpoint import Checkpoint
+
+
+class TrainingFailedError(RmtError):
+    pass
+
+
+class _TrainWorkerImpl:
+    """The per-worker actor (RayTrainWorker analog, worker_group.py:335)."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str):
+        import os
+
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        os.environ["RMT_TRAIN_RANK"] = str(rank)
+        os.environ["RMT_TRAIN_WORLD"] = str(world_size)
+        os.environ["RMT_TRAIN_GROUP"] = group_name
+
+    def _rmt_init_collective(self, world_size, rank, backend, group_name):
+        from ..collective import init_collective_group
+
+        init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+    def run_loop(self, loop_blob: bytes, config: Optional[dict],
+                 checkpoint_blob: Optional[bytes], dataset_shard) -> bool:
+        """Execute the user's train_loop_per_worker to completion. Runs on
+        one actor thread while next_results() is served on another
+        (max_concurrency=2 — the reference pairs a train thread with the
+        session queue the same way)."""
+        import cloudpickle
+
+        from . import session as session_mod
+
+        loop = cloudpickle.loads(loop_blob)
+        checkpoint = (
+            Checkpoint.from_bytes(checkpoint_blob)
+            if checkpoint_blob else None
+        )
+        s = session_mod.init_session(
+            world_rank=self.rank, world_size=self.world_size,
+            checkpoint=checkpoint, dataset_shard=dataset_shard,
+        )
+        try:
+            if config is not None:
+                loop(config)
+            else:
+                loop()
+            return True
+        except BaseException as e:
+            s.error = e
+            raise
+        finally:
+            s.finished.set()
+
+    def next_results(self, timeout_s: float = 1.0) -> Optional[List[dict]]:
+        """Drain queued session.report() payloads; None once the loop has
+        finished and the queue is empty. Checkpoints travel as bytes."""
+        import queue as queue_mod
+
+        from . import session as session_mod
+
+        try:
+            s = session_mod.get_session()
+        except RuntimeError:
+            return None
+        out: List[dict] = []
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                item = s.queue.get(timeout=max(0.0, deadline -
+                                               time.monotonic()))
+            except queue_mod.Empty:
+                break
+            ckpt = item.get("checkpoint")
+            item["checkpoint"] = ckpt.to_bytes() if ckpt else None
+            out.append(item)
+            if not s.queue.empty():
+                continue
+            break
+        if not out and s.finished.is_set() and s.queue.empty():
+            return None
+        return out
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, Any],
+                 placement_strategy: str = "PACK"):
+        from ..core.placement_group import placement_group
+
+        self.num_workers = num_workers
+        self.group_name = f"train_{uuid.uuid4().hex[:8]}"
+        bundle = dict(resources_per_worker) or {"CPU": 1}
+        self.pg = placement_group([bundle] * num_workers,
+                                  strategy=placement_strategy)
+        if not self.pg.wait(60):
+            raise TrainingFailedError(
+                f"placement group for {num_workers} workers "
+                f"({bundle} each) could not be scheduled"
+            )
+        cls = api.remote(_TrainWorkerImpl)
+        self.actors = []
+        for rank in range(num_workers):
+            self.actors.append(
+                cls.options(
+                    max_concurrency=2,
+                    num_cpus=resources_per_worker.get("CPU", 1),
+                    num_tpus=resources_per_worker.get("TPU", 0),
+                    placement_group=self.pg,
+                    placement_group_bundle_index=rank,
+                ).remote(rank, num_workers, self.group_name)
+            )
+
+    def setup_collective(self) -> None:
+        from ..collective import create_collective_group
+
+        create_collective_group(
+            self.actors, self.num_workers, list(range(self.num_workers)),
+            backend="objstore", group_name=self.group_name,
+        )
+
+    def shutdown(self) -> None:
+        from ..core.placement_group import remove_placement_group
+
+        for a in self.actors:
+            try:
+                api.kill(a)
+            except Exception:
+                pass
+        try:
+            from ..collective.coordinator import destroy_coordinator
+
+            destroy_coordinator(self.group_name)
+        except Exception:
+            pass
+        remove_placement_group(self.pg)
+
+
+class BackendExecutor:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, Any]] = None,
+                 placement_strategy: str = "PACK",
+                 use_collective: bool = True):
+        self.num_workers = num_workers
+        self.resources_per_worker = resources_per_worker or {"CPU": 1}
+        self.placement_strategy = placement_strategy
+        self.use_collective = use_collective and num_workers > 1
+        self.group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.group = WorkerGroup(
+            self.num_workers, self.resources_per_worker,
+            self.placement_strategy,
+        )
+        if self.use_collective:
+            self.group.setup_collective()
+
+    def run(
+        self,
+        train_loop: Callable,
+        config: Optional[dict],
+        checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[List[Any]] = None,
+        on_report: Optional[Callable[[List[dict]], None]] = None,
+        poll_interval_s: float = 0.2,
+    ) -> None:
+        """Ship the loop to every worker and drain reports until all loops
+        complete. Raises TrainingFailedError on worker failure."""
+        from ..serialization import dumps_function
+
+        assert self.group is not None, "call start() first"
+        loop_blob = dumps_function(train_loop)
+        ckpt_blob = checkpoint.to_bytes() if checkpoint else None
+        shards = dataset_shards or [None] * self.num_workers
+        done_refs = [
+            a.run_loop.remote(loop_blob, config, ckpt_blob, shards[i])
+            for i, a in enumerate(self.group.actors)
+        ]
+        live = set(range(self.num_workers))
+        try:
+            while live:
+                batches = []
+                refs = [
+                    (i, self.group.actors[i].next_results.remote(0.5))
+                    for i in sorted(live)
+                ]
+                for i, ref in refs:
+                    res = api.get(ref, timeout=120)
+                    if res is None:
+                        live.discard(i)
+                    elif res:
+                        batches.extend(res)
+                if batches and on_report is not None:
+                    on_report(batches)
+                if live:
+                    time.sleep(poll_interval_s)
+            # surface loop errors (worker finished exceptionally)
+            api.get(done_refs, timeout=60)
+        except (ActorError, TaskError, WorkerCrashedError) as e:
+            raise TrainingFailedError(str(e)) from e
+
+    def shutdown(self) -> None:
+        if self.group is not None:
+            self.group.shutdown()
+            self.group = None

@@ -1,0 +1,141 @@
+"""Checkpoint: a value-semantic handle convertible between dict / directory /
+bytes / URI forms.
+
+Mirrors the reference's AIR Checkpoint (python/ray/air/checkpoint.py:42 —
+from_dict:215/to_dict:239, from_directory:327/to_directory:432,
+from_bytes:536/to_bytes:551, from_uri/to_uri). jax pytrees (params/opt state)
+are stored via orbax when saved to a directory, so TPU-sharded trees
+round-trip correctly; plain picklable state rides cloudpickle.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+_PYTREE_KEY = "__rmt_pytree__"
+_SKELETON_KEY = "__rmt_pytree_skeleton__"
+_PICKLE_FILE = "checkpoint.pkl"
+_ORBAX_DIR = "pytree"
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        self._data = data
+        self._directory = directory
+
+    # -- constructors ---------------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=os.path.abspath(path))
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        return cls(data=pickle.loads(blob))
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        if uri.startswith("file://"):
+            return cls.from_directory(uri[len("file://"):])
+        if "://" not in uri:
+            return cls.from_directory(uri)
+        raise ValueError(f"unsupported checkpoint uri {uri!r}")
+
+    # -- conversions ----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        assert self._directory is not None
+        out: Dict[str, Any] = {}
+        pkl = os.path.join(self._directory, _PICKLE_FILE)
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                out.update(pickle.load(f))
+        orbax_path = os.path.join(self._directory, _ORBAX_DIR)
+        if os.path.exists(orbax_path):
+            import jax
+            import numpy as np
+            import orbax.checkpoint as ocp
+
+            # restore as host numpy; consumers re-shard with parallel.
+            # shard_pytree for their own mesh. The saved skeleton supplies
+            # the tree structure orbax needs for restore_args.
+            skeleton = out.pop(_SKELETON_KEY, None)
+            with ocp.PyTreeCheckpointer() as ckptr:
+                if skeleton is not None:
+                    restore_args = jax.tree.map(
+                        lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+                        skeleton,
+                    )
+                    out[_PYTREE_KEY] = ckptr.restore(
+                        orbax_path, restore_args=restore_args)
+                else:
+                    out[_PYTREE_KEY] = ckptr.restore(orbax_path)
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="rmt_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._directory is not None:
+            if os.path.abspath(path) != self._directory:
+                shutil.copytree(self._directory, path, dirs_exist_ok=True)
+            return path
+        data = dict(self._data or {})
+        pytree = data.pop(_PYTREE_KEY, None)
+        if pytree is not None:
+            import jax
+
+            data[_SKELETON_KEY] = jax.tree.map(lambda _: 0, pytree)
+        with open(os.path.join(path, _PICKLE_FILE), "wb") as f:
+            import cloudpickle
+
+            cloudpickle.dump(data, f)
+        if pytree is not None:
+            import orbax.checkpoint as ocp
+
+            target = os.path.join(path, _ORBAX_DIR)
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(target, pytree)
+        return path
+
+    def to_bytes(self) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps(self.to_dict())
+
+    def to_uri(self, uri: str) -> str:
+        if uri.startswith("file://"):
+            self.to_directory(uri[len("file://"):])
+            return uri
+        if "://" not in uri:
+            self.to_directory(uri)
+            return f"file://{uri}"
+        raise ValueError(f"unsupported checkpoint uri {uri!r}")
+
+    # -- pytree sugar ---------------------------------------------------------
+    @classmethod
+    def from_pytree(cls, pytree, extra: Optional[Dict[str, Any]] = None
+                    ) -> "Checkpoint":
+        """Checkpoint carrying a jax pytree (params/opt state); saved with
+        orbax on to_directory()."""
+        data = dict(extra or {})
+        data[_PYTREE_KEY] = pytree
+        return cls(data=data)
+
+    def get_pytree(self):
+        return self.to_dict().get(_PYTREE_KEY)
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._directory}"
+        return f"Checkpoint({kind})"

@@ -1,0 +1,166 @@
+"""Trainers: the user-facing fit() surface.
+
+Mirrors the reference's trainer stack (train/base_trainer.py:328 fit,
+train/data_parallel_trainer.py:52,314) re-targeted for jax:
+
+    trainer = JaxTrainer(
+        train_loop_per_worker,
+        train_loop_config={...},
+        scaling_config=ScalingConfig(num_workers=4, chips_per_worker=4),
+        run_config=RunConfig(name="run", storage_path=...),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+
+Unlike the reference, fit() does NOT detour through the Tune trial runner
+(base_trainer.py:354 wraps every trainer as a Tune trainable); the tune/
+library composes the other way around (Tuner runs trainers), which keeps the
+single-run path dependency-free. Failure handling matches FailureConfig:
+worker-group restart from the latest checkpoint, max_failures times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .backend_executor import BackendExecutor, TrainingFailedError
+from .checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """air/config.py ScalingConfig analog, TPU-first: ``chips_per_worker``
+    replaces GPUs-per-worker; a worker is a host-process."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1)
+        if self.use_tpu and self.chips_per_worker and "TPU" not in res:
+            res["TPU"] = self.chips_per_worker
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: str = "/tmp/rmt_runs"
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    """air Result analog: final metrics + checkpoint + full history."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+
+
+class JaxTrainer:
+    """Data-parallel jax trainer (DataParallelTrainer analog)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.config = train_loop_config
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_checkpoint = resume_from_checkpoint
+
+    # -- dataset sharding -----------------------------------------------------
+    def _shards(self) -> Optional[List[Any]]:
+        if not self.datasets:
+            return None
+        n = self.scaling.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(n)
+            else:
+                parts = [ds] * n
+            for i in range(n):
+                shards[i][name] = parts[i]
+        return shards
+
+    # -- fit ------------------------------------------------------------------
+    def fit(self) -> Result:
+        run_name = self.run_config.name or f"run_{int(time.time())}"
+        run_dir = os.path.join(self.run_config.storage_path, run_name)
+        os.makedirs(run_dir, exist_ok=True)
+
+        history: List[Dict[str, Any]] = []
+        latest_ckpt: List[Optional[Checkpoint]] = [self.resume_checkpoint]
+        ckpt_index = [0]
+
+        def on_report(batch: List[dict]) -> None:
+            for item in batch:
+                if item["rank"] == 0:
+                    history.append(item["metrics"])
+                if item.get("checkpoint") and item["rank"] == 0:
+                    ckpt = Checkpoint.from_bytes(item["checkpoint"])
+                    path = os.path.join(
+                        run_dir, f"checkpoint_{ckpt_index[0]:06d}")
+                    ckpt.to_directory(path)
+                    ckpt_index[0] += 1
+                    latest_ckpt[0] = Checkpoint.from_directory(path)
+
+        failures_left = self.run_config.failure_config.max_failures
+        error: Optional[BaseException] = None
+        while True:
+            executor = BackendExecutor(
+                self.scaling.num_workers,
+                self.scaling.bundle(),
+                self.scaling.placement_strategy,
+            )
+            try:
+                executor.start()
+                executor.run(
+                    self.train_loop, self.config, latest_ckpt[0],
+                    self._shards(), on_report,
+                )
+                error = None
+                break
+            except TrainingFailedError as e:
+                error = e
+                if failures_left > 0:
+                    failures_left -= 1
+                    # elastic restart from the latest checkpoint (the
+                    # reference restarts failed workers the same way)
+                    continue
+                break
+            finally:
+                executor.shutdown()
+
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint=latest_ckpt[0],
+            metrics_history=history,
+            error=error,
+            path=run_dir,
+        )
