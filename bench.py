@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Headline benchmark: core-runtime microbenchmark geomean vs the reference.
+
+Runs the same metrics as the reference's ``ray microbenchmark``
+(release/microbenchmark → ray_perf.py; published numbers in
+release/release_logs/2.0.0/microbenchmark.json, mirrored in BASELINE.md) on
+this runtime and prints ONE JSON line:
+
+    {"metric": ..., "value": <geomean ops-ratio>, "unit": "x_baseline",
+     "vs_baseline": <same>}
+
+vs_baseline > 1.0 means this runtime beats the reference's published
+single-node numbers on the geometric mean across the metric suite. Detailed
+per-metric numbers go to stderr so the stdout line stays machine-parseable.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    import ray_memory_management_tpu as rmt
+    from ray_memory_management_tpu.utils.microbenchmark import (
+        BASELINE, geomean, run_microbenchmark, vs_baseline,
+    )
+
+    rmt.init(num_cpus=8)
+    try:
+        results = run_microbenchmark(scale=1.0)
+        ratios = vs_baseline(results)
+        for k in sorted(results):
+            print(
+                f"  {k:42s} {results[k]:12.1f} "
+                f"(baseline {BASELINE.get(k, float('nan')):10.1f}, "
+                f"{ratios.get(k, 0):5.2f}x)",
+                file=sys.stderr,
+            )
+        gm = geomean(ratios)
+    finally:
+        rmt.shutdown()
+
+    print(json.dumps({
+        "metric": "core runtime microbenchmark geomean "
+                  f"({len(ratios)} metrics vs ray 2.0 release numbers)",
+        "value": round(gm, 4),
+        "unit": "x_baseline",
+        "vs_baseline": round(gm, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -394,20 +394,29 @@ def init(
 
 def _detect_tpu_chips() -> int:
     """TPU autodetection analog of GPU autodetect (_private/resource_spec.py:273):
-    honor TPU_VISIBLE_CHIPS, else count local TPU devices if jax is already
-    imported (never import jax here — it grabs the chips)."""
+    honor TPU_VISIBLE_CHIPS, else count devices of an ALREADY-INITIALIZED
+    accelerator backend. Never import jax or trigger backend creation here —
+    that would claim the chips (and can block on a busy TPU) just because the
+    scheduler asked how many exist."""
     env = os.environ.get("TPU_VISIBLE_CHIPS")
     if env:
         return len([c for c in env.split(",") if c != ""])
     import sys
 
     jax = sys.modules.get("jax")
-    if jax is not None:
-        try:
-            return len([d for d in jax.devices() if d.platform != "cpu"])
-        except Exception:
-            return 0
-    return 0
+    if jax is None:
+        return 0
+    try:
+        from jax._src import xla_bridge
+
+        initialized = getattr(xla_bridge, "_backends", {})
+        count = 0
+        for platform, backend in initialized.items():
+            if platform != "cpu":
+                count += backend.device_count()
+        return count
+    except Exception:
+        return 0
 
 
 def shutdown() -> None:

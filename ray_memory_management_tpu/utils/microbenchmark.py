@@ -1,0 +1,178 @@
+"""Core-runtime microbenchmark suite.
+
+Mirrors the reference's ``ray microbenchmark`` (release/microbenchmark/
+run_microbenchmark.py → python/ray/_private/ray_perf.py; CLI scripts.py:1744):
+the same metric names as release/release_logs/2.0.0/microbenchmark.json so
+results compare one-to-one against BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+# reference numbers from release/release_logs/2.0.0/microbenchmark.json
+# (duplicated in BASELINE.md)
+BASELINE = {
+    "single_client_tasks_sync": 1424.0,
+    "single_client_tasks_async": 13150.0,
+    "1_1_actor_calls_sync": 2490.0,
+    "1_1_actor_calls_async": 6146.0,
+    "1_n_actor_calls_async": 11532.0,
+    "single_client_put_calls": 5390.0,
+    "single_client_get_calls": 5403.0,
+    "single_client_put_gigabytes": 19.67,
+    "placement_group_create/removal": 1243.0,
+}
+
+
+def _timeit(name: str, fn: Callable[[int], None], n: int,
+            warmup: int = 1) -> float:
+    for _ in range(warmup):
+        fn(max(1, n // 10))
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def run_microbenchmark(scale: float = 1.0,
+                       select: Optional[list] = None) -> Dict[str, float]:
+    """Run the suite against the current runtime; returns {metric: ops/s}
+    (or GB/s for put_gigabytes)."""
+    import ray_memory_management_tpu as rmt
+
+    results: Dict[str, float] = {}
+
+    def want(name):
+        return select is None or name in select
+
+    @rmt.remote(max_retries=0)
+    def small_task(x=None):
+        return b"ok"
+
+    @rmt.remote
+    class Sink:
+        def ping(self, x=None):
+            return b"ok"
+
+        async def aping(self, x=None):
+            return b"ok"
+
+    # warm the worker pool so cold starts don't pollute throughput
+    rmt.get([small_task.remote() for _ in range(4)], timeout=120)
+
+    if want("single_client_tasks_sync"):
+        def tasks_sync(n):
+            for _ in range(n):
+                rmt.get(small_task.remote(), timeout=60)
+
+        results["single_client_tasks_sync"] = _timeit(
+            "tasks_sync", tasks_sync, int(300 * scale))
+
+    if want("single_client_tasks_async"):
+        def tasks_async(n):
+            rmt.get([small_task.remote() for _ in range(n)], timeout=300)
+
+        results["single_client_tasks_async"] = _timeit(
+            "tasks_async", tasks_async, int(3000 * scale))
+
+    actor = Sink.remote()
+    rmt.get(actor.ping.remote(), timeout=120)
+
+    if want("1_1_actor_calls_sync"):
+        def actor_sync(n):
+            for _ in range(n):
+                rmt.get(actor.ping.remote(), timeout=60)
+
+        results["1_1_actor_calls_sync"] = _timeit(
+            "actor_sync", actor_sync, int(300 * scale))
+
+    if want("1_1_actor_calls_async"):
+        def actor_async(n):
+            rmt.get([actor.ping.remote() for _ in range(n)], timeout=300)
+
+        results["1_1_actor_calls_async"] = _timeit(
+            "actor_async", actor_async, int(3000 * scale))
+
+    if want("1_n_actor_calls_async"):
+        n_actors = 4
+        actors = [Sink.remote() for _ in range(n_actors)]
+        rmt.get([a.ping.remote() for a in actors], timeout=120)
+
+        def one_n(n):
+            refs = []
+            per = n // n_actors
+            for a in actors:
+                refs.extend(a.ping.remote() for _ in range(per))
+            rmt.get(refs, timeout=300)
+
+        results["1_n_actor_calls_async"] = _timeit(
+            "1_n_actor", one_n, int(3000 * scale))
+
+    if want("single_client_put_calls"):
+        arr = np.ones(50_000, np.float32)  # 200KB -> shared-memory store
+
+        def puts(n):
+            for _ in range(n):
+                rmt.put(arr)
+
+        results["single_client_put_calls"] = _timeit(
+            "puts", puts, int(1000 * scale))
+
+    if want("single_client_get_calls"):
+        ref = rmt.put(np.ones(50_000, np.float32))
+
+        def gets(n):
+            for _ in range(n):
+                rmt.get(ref)
+
+        results["single_client_get_calls"] = _timeit(
+            "gets", gets, int(1000 * scale))
+
+    if want("single_client_put_gigabytes"):
+        chunk = np.ones(16 * 1024 * 1024 // 4, np.float32)  # 16 MB
+        total_gb = 0.5 * scale
+        n_chunks = max(1, int(total_gb * 1024 / 16))
+
+        def put_gb(n):
+            # free each ref immediately: measures store write bandwidth, not
+            # capacity-pressure spilling
+            for _ in range(n):
+                r = rmt.put(chunk)
+                del r
+
+        t0 = time.perf_counter()
+        put_gb(n_chunks)
+        dt = time.perf_counter() - t0
+        results["single_client_put_gigabytes"] = (
+            n_chunks * 16 / 1024) / dt
+
+    if want("placement_group_create/removal"):
+        from ..core.placement_group import (
+            placement_group, remove_placement_group,
+        )
+
+        def pgs(n):
+            for _ in range(n):
+                pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+                pg.wait(5)
+                remove_placement_group(pg)
+
+        results["placement_group_create/removal"] = _timeit(
+            "pgs", pgs, int(300 * scale))
+
+    return results
+
+
+def vs_baseline(results: Dict[str, float]) -> Dict[str, float]:
+    return {
+        k: results[k] / BASELINE[k] for k in results if k in BASELINE
+    }
+
+
+def geomean(ratios: Dict[str, float]) -> float:
+    vals = np.array(list(ratios.values()), dtype=np.float64)
+    return float(np.exp(np.log(vals).mean())) if len(vals) else 0.0
