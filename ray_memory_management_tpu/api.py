@@ -413,7 +413,9 @@ def _detect_tpu_chips() -> int:
         count = 0
         for platform, backend in initialized.items():
             if platform != "cpu":
-                count += backend.device_count()
+                # local count only: on a multi-host slice device_count() is
+                # the global chip count, which would oversubscribe this node
+                count += backend.local_device_count()
         return count
     except Exception:
         return 0

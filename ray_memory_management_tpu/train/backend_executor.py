@@ -59,16 +59,19 @@ class _TrainWorkerImpl:
 
         from . import session as session_mod
 
-        loop = cloudpickle.loads(loop_blob)
-        checkpoint = (
-            Checkpoint.from_bytes(checkpoint_blob)
-            if checkpoint_blob else None
-        )
+        # init the session before anything that can fail or block, so a
+        # concurrent next_results() poll never mistakes "not started yet"
+        # for "finished" (it reports None only after s.finished is set)
         s = session_mod.init_session(
             world_rank=self.rank, world_size=self.world_size,
-            checkpoint=checkpoint, dataset_shard=dataset_shard,
+            checkpoint=None, dataset_shard=dataset_shard,
         )
         try:
+            loop = cloudpickle.loads(loop_blob)
+            s.loaded_checkpoint = (
+                Checkpoint.from_bytes(checkpoint_blob)
+                if checkpoint_blob else None
+            )
             if config is not None:
                 loop(config)
             else:
@@ -90,7 +93,7 @@ class _TrainWorkerImpl:
         try:
             s = session_mod.get_session()
         except RuntimeError:
-            return None
+            return []  # run_loop hasn't started yet — poll again
         out: List[dict] = []
         deadline = time.monotonic() + timeout_s
         while True:
@@ -217,6 +220,21 @@ class BackendExecutor:
                         live.discard(i)
                     elif res:
                         batches.extend(res)
+                    else:
+                        # empty batch: either the loop hasn't started or it
+                        # died before init_session (e.g. a shard failed to
+                        # deserialize). If run_loop already finished, a final
+                        # drain is safe and prevents polling forever.
+                        ready, _ = api.wait([done_refs[i]], timeout=0)
+                        if ready:
+                            api.get(done_refs[i])  # surfaces loop errors
+                            final = api.get(
+                                self.group.actors[i].next_results.remote(0.0),
+                                timeout=120,
+                            )
+                            if final:
+                                batches.extend(final)
+                            live.discard(i)
                 if batches and on_report is not None:
                     on_report(batches)
                 if live:

@@ -28,8 +28,7 @@ BASELINE = {
 }
 
 
-def _timeit(name: str, fn: Callable[[int], None], n: int,
-            warmup: int = 1) -> float:
+def _timeit(fn: Callable[[int], None], n: int, warmup: int = 1) -> float:
     for _ in range(warmup):
         fn(max(1, n // 10))
     t0 = time.perf_counter()
@@ -69,33 +68,30 @@ def run_microbenchmark(scale: float = 1.0,
             for _ in range(n):
                 rmt.get(small_task.remote(), timeout=60)
 
-        results["single_client_tasks_sync"] = _timeit(
-            "tasks_sync", tasks_sync, int(300 * scale))
+        results["single_client_tasks_sync"] = _timeit(tasks_sync, int(300 * scale))
 
     if want("single_client_tasks_async"):
         def tasks_async(n):
             rmt.get([small_task.remote() for _ in range(n)], timeout=300)
 
-        results["single_client_tasks_async"] = _timeit(
-            "tasks_async", tasks_async, int(3000 * scale))
+        results["single_client_tasks_async"] = _timeit(tasks_async, int(3000 * scale))
 
-    actor = Sink.remote()
-    rmt.get(actor.ping.remote(), timeout=120)
+    if want("1_1_actor_calls_sync") or want("1_1_actor_calls_async"):
+        actor = Sink.remote()
+        rmt.get(actor.ping.remote(), timeout=120)
 
     if want("1_1_actor_calls_sync"):
         def actor_sync(n):
             for _ in range(n):
                 rmt.get(actor.ping.remote(), timeout=60)
 
-        results["1_1_actor_calls_sync"] = _timeit(
-            "actor_sync", actor_sync, int(300 * scale))
+        results["1_1_actor_calls_sync"] = _timeit(actor_sync, int(300 * scale))
 
     if want("1_1_actor_calls_async"):
         def actor_async(n):
             rmt.get([actor.ping.remote() for _ in range(n)], timeout=300)
 
-        results["1_1_actor_calls_async"] = _timeit(
-            "actor_async", actor_async, int(3000 * scale))
+        results["1_1_actor_calls_async"] = _timeit(actor_async, int(3000 * scale))
 
     if want("1_n_actor_calls_async"):
         n_actors = 4
@@ -109,8 +105,7 @@ def run_microbenchmark(scale: float = 1.0,
                 refs.extend(a.ping.remote() for _ in range(per))
             rmt.get(refs, timeout=300)
 
-        results["1_n_actor_calls_async"] = _timeit(
-            "1_n_actor", one_n, int(3000 * scale))
+        results["1_n_actor_calls_async"] = _timeit(one_n, int(3000 * scale))
 
     if want("single_client_put_calls"):
         arr = np.ones(50_000, np.float32)  # 200KB -> shared-memory store
@@ -119,8 +114,7 @@ def run_microbenchmark(scale: float = 1.0,
             for _ in range(n):
                 rmt.put(arr)
 
-        results["single_client_put_calls"] = _timeit(
-            "puts", puts, int(1000 * scale))
+        results["single_client_put_calls"] = _timeit(puts, int(1000 * scale))
 
     if want("single_client_get_calls"):
         ref = rmt.put(np.ones(50_000, np.float32))
@@ -129,8 +123,7 @@ def run_microbenchmark(scale: float = 1.0,
             for _ in range(n):
                 rmt.get(ref)
 
-        results["single_client_get_calls"] = _timeit(
-            "gets", gets, int(1000 * scale))
+        results["single_client_get_calls"] = _timeit(gets, int(1000 * scale))
 
     if want("single_client_put_gigabytes"):
         chunk = np.ones(16 * 1024 * 1024 // 4, np.float32)  # 16 MB
@@ -144,11 +137,8 @@ def run_microbenchmark(scale: float = 1.0,
                 r = rmt.put(chunk)
                 del r
 
-        t0 = time.perf_counter()
-        put_gb(n_chunks)
-        dt = time.perf_counter() - t0
-        results["single_client_put_gigabytes"] = (
-            n_chunks * 16 / 1024) / dt
+        chunks_per_s = _timeit(put_gb, n_chunks)
+        results["single_client_put_gigabytes"] = chunks_per_s * 16 / 1024
 
     if want("placement_group_create/removal"):
         from ..core.placement_group import (
@@ -161,8 +151,7 @@ def run_microbenchmark(scale: float = 1.0,
                 pg.wait(5)
                 remove_placement_group(pg)
 
-        results["placement_group_create/removal"] = _timeit(
-            "pgs", pgs, int(300 * scale))
+        results["placement_group_create/removal"] = _timeit(pgs, int(300 * scale))
 
     return results
 
