@@ -51,6 +51,10 @@ _flag("max_workers_per_node", int, 8,
       "Upper bound on pooled workers per node.")
 _flag("worker_lease_timeout_s", float, 30.0,
       "How long a task waits for a worker lease before erroring.")
+_flag("cpu_worker_env_drop", str, "PALLAS_AXON_POOL_IPS",
+      "Comma-separated env vars dropped when spawning CPU-platform workers "
+      "— accelerator-bootstrap triggers (sitecustomize TPU plugin init) "
+      "that would cost seconds of spawn latency a CPU worker never needs.")
 
 # --- fault tolerance ---------------------------------------------------------
 _flag("num_heartbeats_timeout", int, 30,

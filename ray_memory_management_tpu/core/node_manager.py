@@ -108,9 +108,11 @@ class NodeManager:
         if env["JAX_PLATFORMS"] == "cpu":
             # CPU workers skip the TPU plugin bootstrap some images run from
             # sitecustomize at interpreter start (it imports jax + registers a
-            # PJRT backend, ~2s); dropping the trigger env var cuts worker
-            # spawn from ~2s to ~0.2s. TPU-platform workers keep it.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # PJRT backend, ~2s); dropping the trigger env vars cuts worker
+            # spawn from ~2s to ~0.2s. TPU-platform workers keep them.
+            for var in self.config.cpu_worker_env_drop.split(","):
+                if var:
+                    env.pop(var.strip(), None)
         proc = subprocess.Popen(
             [sys.executable, "-m",
              "ray_memory_management_tpu.core.worker_main"],

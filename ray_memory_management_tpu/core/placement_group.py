@@ -55,6 +55,9 @@ class PlacementGroup:
         from .object_ref import ObjectRef
 
         rt = _worker_context.get_runtime()
+        if rt is None:
+            raise PlacementGroupError(
+                "pg.ready() is driver-side; use pg.wait() inside workers")
         mgr = _manager(rt)
         return ObjectRef(mgr.ready_object(self.id), rt)
 
@@ -62,8 +65,12 @@ class PlacementGroup:
         from .. import _worker_context
 
         rt = _worker_context.get_runtime()
-        mgr = _manager(rt)
-        return mgr.wait_created(self.id, timeout_seconds)
+        if rt is not None:
+            return _manager(rt).wait_created(self.id, timeout_seconds)
+        proxy = _worker_context.get_proxy()
+        if proxy is None:
+            raise PlacementGroupError("not initialized")
+        return proxy.wait_placement_group(self.id, timeout_seconds)
 
     @property
     def bundle_count(self) -> int:
@@ -177,7 +184,14 @@ class PlacementGroupManager:
             state = self._groups.get(pg_id)
         if state is None:
             raise PlacementGroupError("unknown placement group")
+        if state.state == REMOVED:
+            return False  # removed groups will never be created
         return state.created_event.wait(timeout)
+
+    def state(self, pg_id: bytes) -> Optional[str]:
+        with self._lock:
+            st = self._groups.get(pg_id)
+            return st.state if st is not None else None
 
     # -- scheduling integration ----------------------------------------------
     def acquire(self, pg_id: bytes, bundle_index: int, req: Resources,
@@ -293,16 +307,27 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     from .. import _worker_context
 
     rt = _worker_context.get_runtime()
-    if rt is None:
-        raise PlacementGroupError("placement groups are driver-side only")
-    return _manager(rt).create(bundles, strategy, name)
+    if rt is not None:
+        return _manager(rt).create(bundles, strategy, name)
+    proxy = _worker_context.get_proxy()
+    if proxy is None:
+        raise PlacementGroupError("not initialized")
+    pg_id = proxy.create_placement_group(bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles, strategy, name)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     from .. import _worker_context
 
+    pg_id = pg.id if isinstance(pg, PlacementGroup) else pg
     rt = _worker_context.get_runtime()
-    _manager(rt).remove(pg.id if isinstance(pg, PlacementGroup) else pg)
+    if rt is not None:
+        _manager(rt).remove(pg_id)
+        return
+    proxy = _worker_context.get_proxy()
+    if proxy is None:
+        raise PlacementGroupError("not initialized")
+    proxy.remove_placement_group(pg_id)
 
 
 def placement_group_table() -> Dict[str, dict]:

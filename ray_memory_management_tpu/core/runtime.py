@@ -1153,6 +1153,25 @@ class Runtime:
                 with self._lock:
                     info = self.actors.get(msg["actor_id"])
                 reply["exists"] = info is not None
+            elif mtype == "create_pg":
+                from .placement_group import _manager
+
+                pg = _manager(self).create(
+                    msg["bundles"], msg["strategy"], msg.get("name", ""))
+                reply["pg_id"] = pg.id
+            elif mtype == "pg_state":
+                from .placement_group import _manager
+
+                reply["state"] = _manager(self).state(msg["pg_id"])
+            elif mtype == "wait_pg":
+                from .placement_group import _manager
+
+                reply["created"] = _manager(self).wait_created(
+                    msg["pg_id"], msg["timeout"])
+            elif mtype == "remove_pg":
+                from .placement_group import _manager
+
+                _manager(self).remove(msg["pg_id"])
             elif mtype == "get_named_actor":
                 rec = self.gcs.get_named_actor(msg["name"])
                 if rec is None:

@@ -174,6 +174,27 @@ class WorkerRuntimeProxy:
         reply = self._request({"type": "get_named_actor", "name": name})
         return reply["actor_id"]
 
+    # placement groups proxy to the driver-side manager so nested libraries
+    # (a Trainer running inside a Tune trial actor) can gang-schedule — the
+    # reference supports the same nesting through its GCS PG manager
+    def create_placement_group(self, bundles, strategy, name="") -> bytes:
+        reply = self._request({"type": "create_pg", "bundles": bundles,
+                               "strategy": strategy, "name": name})
+        return reply["pg_id"]
+
+    def placement_group_state(self, pg_id: bytes):
+        return self._request({"type": "pg_state", "pg_id": pg_id})["state"]
+
+    def wait_placement_group(self, pg_id: bytes, timeout: float) -> bool:
+        # blocks server-side on the request pool (like nested get/wait) —
+        # one round-trip instead of a poll loop
+        reply = self._request({"type": "wait_pg", "pg_id": pg_id,
+                               "timeout": timeout}, timeout=timeout + 30)
+        return reply["created"]
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        self._request({"type": "remove_pg", "pg_id": pg_id})
+
 
 class _ActorState:
     def __init__(self, instance, max_concurrency: int):

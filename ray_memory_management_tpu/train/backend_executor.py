@@ -207,9 +207,18 @@ class BackendExecutor:
             for i, a in enumerate(self.group.actors)
         ]
         live = set(range(self.num_workers))
+        batches: List[dict] = []
+
+        def flush() -> None:
+            # deliver everything collected this round before any error can
+            # propagate — a healthy worker's checkpoint must not be lost
+            # because a peer died mid-round
+            if batches and on_report is not None:
+                on_report(list(batches))
+            batches.clear()
+
         try:
             while live:
-                batches = []
                 refs = [
                     (i, self.group.actors[i].next_results.remote(0.5))
                     for i in sorted(live)
@@ -235,13 +244,13 @@ class BackendExecutor:
                             if final:
                                 batches.extend(final)
                             live.discard(i)
-                if batches and on_report is not None:
-                    on_report(batches)
+                flush()
                 if live:
                     time.sleep(poll_interval_s)
             # surface loop errors (worker finished exceptionally)
             api.get(done_refs, timeout=60)
         except (ActorError, TaskError, WorkerCrashedError) as e:
+            flush()
             raise TrainingFailedError(str(e)) from e
 
     def shutdown(self) -> None:

@@ -1,0 +1,207 @@
+"""Search spaces and search algorithms.
+
+Mirrors the reference's tune search layer (python/ray/tune/search/):
+sample-space primitives (tune/search/sample.py — uniform/loguniform/choice/
+randint/grid_search), `BasicVariantGenerator` (tune/search/basic_variant.py)
+which crosses grid axes and samples stochastic axes, and the `Searcher`
+suggest/on_trial_complete contract (tune/search/searcher.py) used by advanced
+algorithms. This build keeps the same surface but is dependency-free.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(round(v / self.q) * self.q, 10)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    """Marker for exhaustive axes (tune/search/sample.py grid_search)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def _split_space(space: Dict[str, Any]):
+    """Partition a (possibly nested) param space into grid axes and the
+    sampled/constant remainder. Returns (grid_paths, template) where
+    grid_paths is [(key_path, values)]."""
+    grid: List = []
+
+    def walk(node, path):
+        if isinstance(node, GridSearch):
+            grid.append((path, node.values))
+            return None
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        return node
+
+    template = walk(space, ())
+    return grid, template
+
+
+def _materialize(node, rng: random.Random):
+    if isinstance(node, Domain):
+        return node.sample(rng)
+    if isinstance(node, dict):
+        return {k: _materialize(v, rng) for k, v in node.items()}
+    return node
+
+
+def _set_path(cfg: dict, path, value):
+    cur = cfg
+    for key in path[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[path[-1]] = value
+
+
+class BasicVariantGenerator:
+    """Cross-product of grid axes x ``num_samples`` random draws
+    (tune/search/basic_variant.py semantics: num_samples multiplies the
+    grid)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.space = space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> List[Dict[str, Any]]:
+        grid_axes, _ = _split_space(self.space)
+        out: List[Dict[str, Any]] = []
+        grid_combos: List[List] = (
+            [list(combo) for combo in
+             itertools.product(*[vals for _, vals in grid_axes])]
+            if grid_axes else [[]]
+        )
+        for _ in range(self.num_samples):
+            for combo in grid_combos:
+                _, template = _split_space(self.space)
+                cfg = _materialize(template, self.rng)
+                if not isinstance(cfg, dict):
+                    cfg = {}
+                for (path, _vals), value in zip(grid_axes, combo):
+                    _set_path(cfg, path, value)
+                out.append(cfg)
+        return out
+
+
+class Searcher:
+    """suggest/on_trial_complete contract (tune/search/searcher.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class RandomSearch(Searcher):
+    """Pure random sampling searcher over a Domain space."""
+
+    def __init__(self, space: Dict[str, Any], metric: str = "loss",
+                 mode: str = "min", seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        _, template = _split_space(self.space)
+        return _materialize(template, self.rng)

@@ -1,0 +1,209 @@
+"""Tune-equivalent tests: search spaces, trial runner, ASHA, PBT.
+
+Mirrors the reference's tune test strategy (python/ray/tune/tests/
+test_tune_restore.py, test_trial_scheduler.py style): function + class
+trainables driven end-to-end on an in-process cluster.
+"""
+
+import pytest
+
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu import tune
+from ray_memory_management_tpu.train import session
+
+
+def test_grid_and_sample_variants():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.choice([1, 2]),
+        "nested": {"depth": tune.grid_search([2, 4])},
+    }
+    variants = tune.BasicVariantGenerator(space, num_samples=2,
+                                          seed=0).variants()
+    assert len(variants) == 2 * 2 * 2  # num_samples x grid(lr) x grid(depth)
+    lrs = {v["lr"] for v in variants}
+    depths = {v["nested"]["depth"] for v in variants}
+    assert lrs == {0.1, 0.01}
+    assert depths == {2, 4}
+    assert all(v["wd"] in (1, 2) for v in variants)
+
+
+def test_sample_domains_deterministic_seed():
+    space = {"a": tune.uniform(0, 1), "b": tune.randint(0, 10),
+             "c": tune.loguniform(1e-4, 1e-1), "d": tune.quniform(0, 1, 0.25)}
+    v1 = tune.BasicVariantGenerator(space, 3, seed=42).variants()
+    v2 = tune.BasicVariantGenerator(space, 3, seed=42).variants()
+    assert v1 == v2
+    assert all(0 <= v["a"] <= 1 for v in v1)
+    assert all(v["d"] in (0.0, 0.25, 0.5, 0.75, 1.0) for v in v1)
+
+
+class _Quadratic(tune.Trainable):
+    """loss = (x - 3)^2 shrinking each iteration."""
+
+    def setup(self, config):
+        self.x = config.get("x", 0.0)
+        self.value = (self.x - 3.0) ** 2
+
+    def step(self):
+        self.value *= 0.5
+        return {"loss": self.value}
+
+    def save_checkpoint(self, d):
+        with open(f"{d}/state.txt", "w") as f:
+            f.write(str(self.value))
+
+    def load_checkpoint(self, d):
+        with open(f"{d}/state.txt") as f:
+            self.value = float(f.read())
+
+
+def test_tuner_class_trainable_grid(rmt_start_regular):
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([0.0, 2.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_iterations=3),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 2.0  # closest to 3
+    assert len(best.metrics_history) == 3
+
+
+def test_tuner_function_trainable(rmt_start_regular):
+    def train_fn(config):
+        acc = 0.0
+        for _ in range(4):
+            acc += config["lr"]
+            session.report({"acc": acc})
+
+    tuner = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([0.1, 0.3])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max"),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["lr"] == 0.3
+    assert best.metrics["acc"] == pytest.approx(1.2)
+
+
+def test_tuner_trial_error_surfaces(rmt_start_regular):
+    def bad_fn(config):
+        if config["boom"]:
+            raise ValueError("exploded")
+        session.report({"ok": 1})
+
+    grid = tune.Tuner(
+        bad_fn,
+        param_space={"boom": tune.grid_search([False, True])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert "exploded" in grid.errors[0]
+    assert grid.get_best_result().config["boom"] is False
+
+
+def test_asha_stops_bad_trials(rmt_start_regular):
+    asha = tune.ASHAScheduler(metric="loss", mode="min", max_t=16,
+                              grace_period=2, reduction_factor=2)
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([3.0, 100.0, 200.0, 400.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=asha, max_iterations=16,
+                                    max_concurrent_trials=2),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    iters = {r.config["x"]: len(r.metrics_history) for r in grid}
+    # the best trial (x=3, loss=0) must survive to max_t; at least one of
+    # the far-off trials must have been halted early at a rung
+    assert iters[3.0] == 16
+    assert min(iters[x] for x in (100.0, 200.0, 400.0)) < 16
+
+
+def test_pbt_exploits_and_perturbs(rmt_start_regular, tmp_path):
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.5, 2.0)},
+        quantile_fraction=0.5, seed=1,
+    )
+    pace_dir = str(tmp_path)
+
+    class _Grower(tune.Trainable):
+        """Trials pace each other through files so neither can finish before
+        the other reports (exploit needs both trials' scores recorded)."""
+
+        def setup(self, config):
+            self.total = 0.0
+            self.steps = 0
+
+        def step(self):
+            import os
+            import time as _t
+
+            me = f"{self.config['rate']}"
+            self.steps += 1
+            with open(f"{pace_dir}/{me}.{self.steps}", "w"):
+                pass
+            deadline = _t.monotonic() + 30
+            # wait for the peer to reach the previous step
+            want = self.steps - 1
+            while want > 0 and _t.monotonic() < deadline:
+                peers = [f for f in os.listdir(pace_dir)
+                         if not f.startswith(me) and
+                         int(f.rsplit(".", 1)[1]) >= want]
+                if peers:
+                    break
+                _t.sleep(0.01)
+            self.total += self.config.get("rate", 0.0)
+            return {"score": self.total}
+
+        def save_checkpoint(self, d):
+            with open(f"{d}/t.txt", "w") as f:
+                f.write(str(self.total))
+
+        def load_checkpoint(self, d):
+            with open(f"{d}/t.txt") as f:
+                self.total = float(f.read())
+
+        def reset_config(self, new_config):
+            return True
+
+    tuner = tune.Tuner(
+        _Grower,
+        param_space={"rate": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=pbt, max_iterations=12),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    scores = sorted(r.metrics["score"] for r in grid)
+    # the weak trial must have cloned the strong trial's state at least once:
+    # without exploit its score would be 12*0.01 = 0.12
+    assert scores[0] > 1.0
+
+
+def test_tuner_runs_jax_trainer(rmt_start_regular):
+    from ray_memory_management_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        session.report({"loss": (config["lr"] - 0.2) ** 2})
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+    ).fit()
+    assert not grid.errors
+    assert grid.get_best_result().config["lr"] == 0.2
