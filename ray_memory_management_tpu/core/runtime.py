@@ -570,6 +570,10 @@ class Runtime:
     # ------------------------------------------------------------ completion
     def _on_task_done(self, handle: WorkerHandle, msg: dict) -> None:
         task_id = msg["task_id"]
+        if msg.get("profile"):
+            from ..utils import timeline
+
+            timeline.ingest_events(msg["profile"])
         nm = self.nodes.get(handle.node_id)
         spec = handle.inflight.get(task_id)
         if nm:

@@ -28,6 +28,7 @@ import asyncio
 import inspect
 import os
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -303,6 +304,7 @@ class Worker:
     def exec_task(self, msg: dict) -> None:
         task_id = msg["task_id"]
         pinned: List[bytes] = []
+        t0 = time.time()
         try:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
@@ -322,7 +324,21 @@ class Worker:
         finally:
             for oid in pinned:
                 self.store.release(oid)
+        reply["profile"] = self._profile_batch(
+            f"task::{msg.get('name', 'task')}", t0)
         self.sender.send(reply)
+
+    def _profile_batch(self, span_name: str, t0: float) -> List[dict]:
+        """Record this task's execution span and flush buffered user
+        profile() events — the worker→GCS ProfileEvent batch path
+        (src/ray/core_worker/profiling.h:30) riding the done reply."""
+        from ..utils import timeline
+
+        timeline.record_event(
+            span_name, "task", t0, time.time(),
+            pid=f"worker:{self.worker_id.hex()[:8]}",
+        )
+        return timeline.drain_events()
 
     @staticmethod
     def _split_returns(result, return_ids):
@@ -393,16 +409,48 @@ class Worker:
             })
             return
         pinned: List[bytes] = []
+        t0 = time.time()
         try:
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             if inspect.iscoroutinefunction(method):
+                # Async methods run as coroutines on the actor's loop and do
+                # NOT hold this executor thread while awaiting (fiber.h
+                # semantics: max_concurrency bounds threads for sync methods,
+                # while any number of coroutines may be parked on awaits —
+                # e.g. many blocked queue getters). The done callback (on the
+                # loop thread) sends the reply and releases pinned args.
                 loop = state.ensure_loop()
                 fut = asyncio.run_coroutine_threadsafe(
                     method(*args, **kwargs), loop
                 )
-                result = fut.result()
-            else:
-                result = method(*args, **kwargs)
+                fut.add_done_callback(
+                    lambda f, p=pinned: self._finish_actor_task(
+                        msg, t0, p, f)
+                )
+                return
+            result = method(*args, **kwargs)
+            returns = self._split_returns(result, msg["return_ids"])
+            reply = {
+                "type": "done", "task_id": task_id,
+                "returns": self.encode_returns(returns, msg["return_ids"]),
+                "error": None,
+            }
+        except BaseException as e:  # noqa: BLE001
+            reply = {"type": "done", "task_id": task_id, "returns": [],
+                     "error": self._encode_error(msg["method"], e)}
+        for oid in pinned:
+            self.store.release(oid)
+        reply["profile"] = self._profile_batch(
+            f"actor::{msg.get('name', msg['method'])}", t0)
+        self.sender.send(reply)
+
+    def _finish_actor_task(self, msg: dict, t0: float, pinned: List[bytes],
+                           fut) -> None:
+        """Completion callback for async actor methods (runs on the actor's
+        loop thread when the coroutine finishes)."""
+        task_id = msg["task_id"]
+        try:
+            result = fut.result()
             returns = self._split_returns(result, msg["return_ids"])
             reply = {
                 "type": "done", "task_id": task_id,
@@ -415,6 +463,8 @@ class Worker:
         finally:
             for oid in pinned:
                 self.store.release(oid)
+        reply["profile"] = self._profile_batch(
+            f"actor::{msg.get('name', msg['method'])}", t0)
         self.sender.send(reply)
 
     # -- main loop ------------------------------------------------------------

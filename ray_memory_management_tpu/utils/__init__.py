@@ -12,3 +12,5 @@ from ..core.scheduling_strategies import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
     TopologySchedulingStrategy,
 )
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Empty, Full, Queue  # noqa: F401

@@ -1,0 +1,132 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+API-compatible with the reference's ``ray.util.ActorPool``
+(python/ray/util/actor_pool.py): map / map_unordered / submit /
+get_next / get_next_unordered / has_next / has_free / pop_idle /
+push. Used by libraries (Data actor-compute, Tune) to reuse warm
+actors instead of re-creating them per task.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+from .. import api
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        """Apply fn(actor, value) across the pool; yields results in
+        submission order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if not self._idle_actors and not self._future_to_actor:
+            raise RuntimeError("ActorPool has no actors")
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            if isinstance(future, list):
+                raise ValueError("ActorPool methods must return one ref")
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def _next_ordered_future(self):
+        """The future for the smallest not-yet-collected index, skipping
+        indexes already consumed by get_next_unordered."""
+        while True:
+            while (self._next_return_index < self._next_task_index
+                   and self._next_return_index not in self._index_to_future):
+                self._next_return_index += 1
+            fut = self._index_to_future.get(self._next_return_index)
+            if fut is not None:
+                return fut
+            if not self._pending_submits:
+                raise StopIteration("no more results to get")
+            if not self._idle_actors:
+                raise RuntimeError(
+                    "pending submits but no actors left in the pool"
+                )
+            self._drain_pending()
+
+    def get_next(self, timeout: float = None):
+        """Next result in submission order. A timeout leaves the result
+        collectable; a task exception still returns the actor to the pool."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        future = self._next_ordered_future()
+        ready, _ = api.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        try:
+            return api.get(future)
+        finally:
+            self._return_actor(future)
+
+    def get_next_unordered(self, timeout: float = None):
+        """Next available result, any order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        self._drain_pending()
+        ready, _ = api.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, _actor = self._future_to_actor[future]
+        del self._index_to_future[idx]
+        try:
+            return api.get(future)
+        finally:
+            self._return_actor(future)
+
+    def _return_actor(self, future) -> None:
+        _, actor = self._future_to_actor.pop(future)
+        self._idle_actors.append(actor)
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        while self._pending_submits and self._idle_actors:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def has_free(self) -> bool:
+        return bool(self._idle_actors) and not self._pending_submits
+
+    def pop_idle(self):
+        if self.has_free():
+            return self._idle_actors.pop()
+        return None
+
+    def push(self, actor: Any) -> None:
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("actor already in pool")
+        self._idle_actors.append(actor)
+        self._drain_pending()

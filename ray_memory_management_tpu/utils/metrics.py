@@ -1,0 +1,261 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Mirrors the reference's ``ray.util.metrics`` (python/ray/util/metrics.py:155
+Counter, :220 Gauge, :295 Histogram): tag-keyed instruments registered in a
+process-local registry, exportable as Prometheus text (the reference exports
+through the per-node metrics agent → Prometheus, src/ray/stats/metric_exporter.h).
+There is no agent process here; ``export_prometheus()`` renders the registry
+directly and the dashboard/state API reads it in-process.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+TagKey = Tuple[Tuple[str, str], ...]
+
+
+def _tag_key(tags: Optional[Dict[str, str]],
+             default_tags: Dict[str, str]) -> TagKey:
+    merged = dict(default_tags)
+    if tags:
+        merged.update(tags)
+    return tuple(sorted(merged.items()))
+
+
+class Metric:
+    """Base: name, help text, declared tag keys, default tag values."""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # Re-creating a metric with an existing name must NOT shadow the old
+        # one's data (the reference aggregates by name in the metrics agent):
+        # the first instance stays registered and later instances alias its
+        # storage via _share_state.
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                if type(existing) is not type(self):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}"
+                    )
+            else:
+                _registry[name] = self
+        self._prior = existing
+
+    def _adopt_prior(self) -> None:
+        """Alias the first-registered instance's storage (called by each
+        subclass at the end of __init__, after its storage attrs exist)."""
+        if self._prior is not None:
+            self._lock = self._prior._lock
+            self._share_state(self._prior)
+
+    def _share_state(self, other: "Metric") -> None:
+        raise NotImplementedError
+
+    @property
+    def info(self) -> dict:
+        return {
+            "name": self._name,
+            "description": self._description,
+            "tag_keys": self._tag_keys,
+            "default_tags": dict(self._default_tags),
+        }
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        for k in tags:
+            if k not in self._tag_keys:
+                raise ValueError(f"unknown tag key {k!r}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _check_tags(self, tags: Optional[Dict[str, str]]) -> None:
+        if tags:
+            for k in tags:
+                if k not in self._tag_keys:
+                    raise ValueError(
+                        f"tag key {k!r} not declared for metric "
+                        f"{self._name!r}"
+                    )
+
+
+class Counter(Metric):
+    """Monotonic counter (util/metrics.py:155)."""
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagKey, float] = {}
+        self._adopt_prior()
+
+    def _share_state(self, other: "Counter") -> None:
+        self._values = other._values
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc() requires value > 0")
+        self._check_tags(tags)
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def series(self) -> Dict[TagKey, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    """Last-value gauge (util/metrics.py:220)."""
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagKey, float] = {}
+        self._adopt_prior()
+
+    def _share_state(self, other: "Gauge") -> None:
+        self._values = other._values
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        self._check_tags(tags)
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def series(self) -> Dict[TagKey, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Histogram(Metric):
+    """Bucketed histogram (util/metrics.py:295). ``boundaries`` are the
+    upper bounds of the finite buckets; +Inf is implicit."""
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires non-empty boundaries")
+        bs = list(boundaries)
+        if bs != sorted(bs) or any(b <= 0 for b in bs):
+            raise ValueError("boundaries must be positive and ascending")
+        self._boundaries = bs
+        self._counts: Dict[TagKey, List[int]] = {}
+        self._sums: Dict[TagKey, float] = {}
+        self._totals: Dict[TagKey, int] = {}
+        self._adopt_prior()
+
+    def _share_state(self, other: "Histogram") -> None:
+        if other._boundaries != self._boundaries:
+            raise ValueError(
+                f"histogram {self._name!r} re-registered with different "
+                "boundaries"
+            )
+        self._counts = other._counts
+        self._sums = other._sums
+        self._totals = other._totals
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        self._check_tags(tags)
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self._boundaries) + 1))
+            idx = len(self._boundaries)
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> dict:
+        key = _tag_key(tags, self._default_tags)
+        with self._lock:
+            counts = self._counts.get(
+                key, [0] * (len(self._boundaries) + 1))
+            return {
+                "buckets": list(zip(self._boundaries + [math.inf], counts)),
+                "sum": self._sums.get(key, 0.0),
+                "count": self._totals.get(key, 0),
+            }
+
+    def series(self):
+        with self._lock:
+            return {k: (list(v), self._sums.get(k, 0.0),
+                        self._totals.get(k, 0))
+                    for k, v in self._counts.items()}
+
+
+def _escape_label(v: str) -> str:
+    # Prometheus exposition format: label values escape \, " and newline
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt_tags(key: TagKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def export_prometheus() -> str:
+    """Render every registered metric as Prometheus exposition text (the
+    metrics-agent endpoint the dashboard scrapes in the reference)."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        name = m.info["name"]
+        lines.append(f"# HELP {name} {m.info['description']}")
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {name} counter")
+            for key, v in m.series().items():
+                lines.append(f"{name}{_fmt_tags(key)} {v}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            for key, v in m.series().items():
+                lines.append(f"{name}{_fmt_tags(key)} {v}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {name} histogram")
+            for key, (counts, total_sum, count) in m.series().items():
+                cum = 0
+                for b, c in zip(m._boundaries + [math.inf], counts):
+                    cum += c
+                    le = "+Inf" if b == math.inf else repr(b)
+                    tag = dict(key)
+                    tag["le"] = le
+                    lines.append(
+                        f"{name}_bucket{_fmt_tags(tuple(sorted(tag.items())))}"
+                        f" {cum}")
+                lines.append(f"{name}_sum{_fmt_tags(key)} {total_sum}")
+                lines.append(f"{name}_count{_fmt_tags(key)} {count}")
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()

@@ -1,0 +1,159 @@
+"""Distributed FIFO queue backed by an actor.
+
+Mirrors the reference's ``ray.util.queue.Queue``: a thin client around an
+async queue actor, with blocking/non-blocking put/get, timeouts, batch
+ops, and the same Empty/Full exceptions. The actor runs its queue on the
+per-actor asyncio loop (the reference uses an async actor too), so many
+blocked getters/putters coexist; ``max_concurrency`` widens the actor's
+executor so blocking calls don't starve each other.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as _stdlib_queue
+from typing import Any, List, Optional
+
+from .. import api
+
+
+class Empty(_stdlib_queue.Empty):
+    pass
+
+
+class Full(_stdlib_queue.Full):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self.queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self.queue.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return True, await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    # every method is async so all queue mutations happen on the actor's
+    # event loop — asyncio.Queue is not thread-safe, and sync methods would
+    # run on executor threads instead
+    async def put_nowait(self, item) -> bool:
+        try:
+            self.queue.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def put_nowait_batch(self, items: List[Any]) -> bool:
+        if self.maxsize and self.queue.qsize() + len(items) > self.maxsize:
+            return False
+        for item in items:
+            self.queue.put_nowait(item)
+        return True
+
+    async def get_nowait(self):
+        try:
+            return True, self.queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def get_nowait_batch(self, num_items: int):
+        if self.queue.qsize() < num_items:
+            return False, None
+        return True, [self.queue.get_nowait() for _ in range(num_items)]
+
+    async def qsize(self) -> int:
+        return self.queue.qsize()
+
+    async def empty(self) -> bool:
+        return self.queue.empty()
+
+    async def full(self) -> bool:
+        return self.queue.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 16)
+        opts.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = api.remote(_QueueActor).options(**opts).remote(maxsize)
+
+    def __reduce__(self):
+        # queues are passed between tasks/actors; rebuild as a client handle
+        return (_rebuild_queue, (self.maxsize, self.actor))
+
+    def qsize(self) -> int:
+        return api.get(self.actor.qsize.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return api.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return api.get(self.actor.full.remote())
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not api.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        ok = api.get(self.actor.put.remote(item, timeout))
+        if not ok:
+            raise Full
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            ok, item = api.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        ok, item = api.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not api.get(self.actor.put_nowait_batch.remote(list(items))):
+            raise Full
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        ok, items = api.get(self.actor.get_nowait_batch.remote(num_items))
+        if not ok:
+            raise Empty
+        return items
+
+    def shutdown(self, force: bool = False) -> None:
+        if self.actor is not None:
+            api.kill(self.actor)
+        self.actor = None
+
+
+def _rebuild_queue(maxsize, actor):
+    q = Queue.__new__(Queue)
+    q.maxsize = maxsize
+    q.actor = actor
+    return q

@@ -1,0 +1,135 @@
+"""Tracing/profiling: per-process event collection + chrome-trace dumps.
+
+The reference batches per-worker ``ProfileEvent``s to GCS
+(src/ray/core_worker/profiling.h:30,64) and renders them with
+``ray timeline`` → ``state.chrome_tracing_dump`` (_private/state.py:413);
+user code wraps hot ops in ``profiling.profile("ray.get")``
+(_private/worker.py:2261). Here the same shape, host-process native:
+
+  - every process (driver or worker) records events into a local buffer
+    via ``profile(name)``;
+  - workers piggyback their buffered events on task-done replies (the
+    profiling.h batch-to-GCS path collapsed onto the existing pipe);
+  - the driver-side collector aggregates everything; ``dump_timeline``
+    emits Chrome ``traceEvents`` JSON loadable in chrome://tracing or
+    Perfetto, exactly like the reference's timeline dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+# Bounded ring: the driver collects one span per task forever, so an
+# unbounded list would grow linearly with tasks submitted (the reference
+# offloads to GCS with its own retention). Oldest events drop first.
+MAX_EVENTS = 200_000
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=MAX_EVENTS)
+_enabled = True
+
+
+def record_event(name: str, cat: str, start: float, end: float,
+                 pid: Any = None, tid: Any = None,
+                 extra: Optional[dict] = None) -> None:
+    """Record one complete ("ph":"X") span. Timestamps are time.time()
+    seconds; converted to microseconds at dump time."""
+    if not _enabled:
+        return
+    ev = {
+        "name": name,
+        "cat": cat,
+        "start": start,
+        "end": end,
+        "pid": pid if pid is not None else f"pid:{os.getpid()}",
+        "tid": tid if tid is not None else threading.get_ident(),
+    }
+    if extra:
+        ev["args"] = extra
+    with _lock:
+        _events.append(ev)
+
+
+class profile:
+    """Context manager recording a named span (reference
+    ``profiling.profile``, src/ray/core_worker/profiling.h:64)."""
+
+    def __init__(self, name: str, extra: Optional[dict] = None,
+                 cat: str = "user"):
+        self._name = name
+        self._extra = extra
+        self._cat = cat
+        self._start = 0.0
+
+    def __enter__(self):
+        self._start = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        record_event(self._name, self._cat, self._start, time.time(),
+                     extra=self._extra)
+        return False
+
+
+def drain_events() -> List[dict]:
+    """Take and clear the local buffer (worker flush path)."""
+    with _lock:
+        evs = list(_events)
+        _events.clear()
+    return evs
+
+
+def ingest_events(events: List[dict]) -> None:
+    """Driver-side: merge a batch shipped from a worker."""
+    if not events:
+        return
+    with _lock:
+        _events.extend(events)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = flag
+
+
+def chrome_trace_events() -> List[dict]:
+    """Render collected events as Chrome trace 'X' events (the
+    chrome_tracing_dump format, _private/state.py:413)."""
+    with _lock:
+        evs = list(_events)
+    out = []
+    for ev in evs:
+        entry = {
+            "name": ev["name"],
+            "cat": ev.get("cat", "user"),
+            "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": max(0.0, (ev["end"] - ev["start"]) * 1e6),
+            "pid": ev.get("pid", 0),
+            "tid": ev.get("tid", 0),
+        }
+        if "args" in ev:
+            entry["args"] = ev["args"]
+        out.append(entry)
+    return out
+
+
+def dump_timeline(filename: Optional[str] = None):
+    """Write (or return) the Chrome trace. ``api.timeline`` entry point —
+    the ``ray timeline`` CLI analog (scripts.py:1758)."""
+    trace = chrome_trace_events()
+    if filename is None:
+        return trace
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
