@@ -34,14 +34,47 @@ def _is_jax_array(value) -> bool:
 
 
 class _JaxAwarePickler(pickle.Pickler):
-    """Pickler that ships jax.Arrays as host numpy + a rebuild marker."""
+    """Pickler that ships jax.Arrays as host numpy + a rebuild marker, and
+    closures/lambdas/script-local functions by value via cloudpickle (plain
+    pickle can only reference importable module-level names; the reference
+    routes all of this through cloudpickle too)."""
 
     def reducer_override(self, obj):
         if _is_jax_array(obj):
             import numpy as np
 
             return (_rebuild_jax_array, (np.asarray(obj),))
+        import types
+
+        if isinstance(obj, types.FunctionType) and _needs_by_value(obj):
+            return (_loads_cloudpickle, (dumps_function(obj),))
         return NotImplemented
+
+
+def _needs_by_value(fn) -> bool:
+    qualname = getattr(fn, "__qualname__", "")
+    if "<locals>" in qualname or "<lambda>" in qualname:
+        return True
+    mod = getattr(fn, "__module__", None)
+    if mod in (None, "__main__"):
+        return True
+    if mod.startswith("ray_memory_management_tpu"):
+        return False
+    module = sys.modules.get(mod)
+    f = getattr(module, "__file__", None)
+    if f is None:
+        return False  # builtin/frozen: importable everywhere
+    import sysconfig
+
+    paths = sysconfig.get_paths()
+    return not f.startswith(
+        (paths["purelib"], paths["platlib"], paths["stdlib"]))
+
+
+def _loads_cloudpickle(blob: bytes):
+    import cloudpickle
+
+    return cloudpickle.loads(blob)
 
 
 def _rebuild_jax_array(np_value):
@@ -214,10 +247,10 @@ def dumps_function(fn) -> bytes:
         and mod.__name__ != "__main__"
         and not mod.__name__.startswith("ray_memory_management_tpu")
     ):
-        site = sysconfig.get_paths()["purelib"]
-        std = sysconfig.get_paths()["stdlib"]
+        paths = sysconfig.get_paths()
         f = mod.__file__
-        if not f.startswith(site) and not f.startswith(std):
+        if not f.startswith(
+                (paths["purelib"], paths["platlib"], paths["stdlib"])):
             try:
                 cloudpickle.register_pickle_by_value(mod)
                 registered = True
