@@ -206,15 +206,23 @@ class _ActorState:
         )
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.loop_thread: Optional[threading.Thread] = None
+        # Bounds concurrent coroutines to max_concurrency (the reference
+        # caps async actors the same way; threads bound only sync methods).
+        self.async_sem: Optional[asyncio.Semaphore] = None
+        self._loop_lock = threading.Lock()
 
     def ensure_loop(self) -> asyncio.AbstractEventLoop:
-        if self.loop is None:
-            self.loop = asyncio.new_event_loop()
-            self.loop_thread = threading.Thread(
-                target=self.loop.run_forever, daemon=True, name="actor-asyncio"
-            )
-            self.loop_thread.start()
-        return self.loop
+        # called from executor threads concurrently; exactly one loop/actor
+        with self._loop_lock:
+            if self.loop is None:
+                self.loop = asyncio.new_event_loop()
+                self.async_sem = asyncio.Semaphore(self.max_concurrency)
+                self.loop_thread = threading.Thread(
+                    target=self.loop.run_forever, daemon=True,
+                    name="actor-asyncio"
+                )
+                self.loop_thread.start()
+            return self.loop
 
 
 class Worker:
@@ -420,9 +428,12 @@ class Worker:
                 # e.g. many blocked queue getters). The done callback (on the
                 # loop thread) sends the reply and releases pinned args.
                 loop = state.ensure_loop()
-                fut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), loop
-                )
+
+                async def _bounded(m=method, a=args, kw=kwargs, s=state):
+                    async with s.async_sem:
+                        return await m(*a, **kw)
+
+                fut = asyncio.run_coroutine_threadsafe(_bounded(), loop)
                 fut.add_done_callback(
                     lambda f, p=pinned: self._finish_actor_task(
                         msg, t0, p, f)

@@ -1,0 +1,24 @@
+"""Serve library: online model serving over the actor runtime.
+
+The reference's ``ray.serve`` (python/ray/serve/ — controller actor,
+deployment/replica reconciler, router with in-flight caps, long-poll
+config push, autoscaling, HTTP proxies).
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_deployment_handle,
+    get_handle,
+    list_deployments,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .deployment import (  # noqa: F401
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    deployment,
+)
+from .handle import DeploymentHandle  # noqa: F401

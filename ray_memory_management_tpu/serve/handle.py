@@ -1,0 +1,160 @@
+"""Deployment handles + client-side router.
+
+The reference's RayServeHandle (serve/handle.py:77,285) backed by the
+Router/ReplicaSet with in-flight caps (serve/_private/router.py:62,261,298)
+and a LongPollClient keeping the routing table fresh
+(serve/_private/long_poll.py:179).
+
+Router policy: pick the live replica with the fewest locally-tracked
+in-flight requests (power-of-all least-loaded); when every replica is at
+``max_concurrent_queries``, block on wait() until one drains — the
+reference's backpressure behavior.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import api
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._lock = threading.Lock()
+        self._version = -1
+        self._replicas: Dict[str, Any] = {}
+        self._max_q = 100
+        self._inflight: Dict[str, List[Any]] = {}
+        self._stop = threading.Event()
+        self._refresh(block=True)
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"serve-poll-{deployment_name}")
+        self._poller.start()
+
+    def _refresh(self, block: bool = False) -> None:
+        state = api.get(
+            self._controller.get_replicas.remote(self._name), timeout=30)
+        deadline = time.monotonic() + 30
+        while block and not state["replicas"] and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+            state = api.get(
+                self._controller.get_replicas.remote(self._name), timeout=30)
+        with self._lock:
+            self._version = state["version"]
+            self._replicas = state["replicas"] or {}
+            self._max_q = state.get("max_concurrent_queries", 100)
+            self._inflight = {
+                t: self._inflight.get(t, []) for t in self._replicas
+            }
+
+    def _poll_loop(self) -> None:
+        """LongPollClient: blocks server-side until the table changes."""
+        while not self._stop.is_set():
+            try:
+                state = api.get(self._controller.listen.remote(
+                    self._name, self._version, 10.0), timeout=40)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.5)
+                continue
+            if state.get("replicas") is None:
+                continue  # timeout tick
+            with self._lock:
+                self._version = state["version"]
+                self._replicas = state["replicas"] or {}
+                self._max_q = state.get("max_concurrent_queries", 100)
+                self._inflight = {
+                    t: self._inflight.get(t, []) for t in self._replicas
+                }
+
+    def _prune(self) -> None:
+        # drop completed refs from in-flight tracking (router.py:298 —
+        # the reference decrements on reply callbacks; we poll readiness)
+        for tag, refs in self._inflight.items():
+            if not refs:
+                continue
+            ready, not_ready = api.wait(
+                refs, num_returns=len(refs), timeout=0)
+            self._inflight[tag] = list(not_ready)
+
+    def assign(self, method: str, args, kwargs):
+        deadline = time.monotonic() + 60
+        while True:
+            with self._lock:
+                self._prune()
+                candidates = [
+                    (len(self._inflight.get(t, [])), t, h)
+                    for t, h in self._replicas.items()
+                ]
+                open_slots = [c for c in candidates if c[0] < self._max_q]
+                if open_slots:
+                    open_slots.sort(key=lambda c: (c[0], random.random()))
+                    _, tag, handle = open_slots[0]
+                    ref = handle.handle_request.remote(method, args, kwargs)
+                    self._inflight.setdefault(tag, []).append(ref)
+                    return ref
+                pending = [r for refs in self._inflight.values()
+                           for r in refs]
+            if not pending:
+                # no replicas yet: wait for the routing table to fill
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"no replicas available for {self._name}")
+                time.sleep(0.05)
+                continue
+            # every replica at max_concurrent_queries: wait for one to drain
+            api.wait(pending, num_returns=1, timeout=1.0)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"backpressure timeout routing to {self._name}")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+
+class DeploymentHandle:
+    """User-facing handle: ``h.remote(*args)`` → ObjectRef; method handles
+    via ``h.method_name.remote(...)`` (reference handle.py:285
+    RayServeSyncHandle / method handles)."""
+
+    def __init__(self, controller, deployment_name: str,
+                 method: str = "__call__", _router: Optional[Router] = None):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method
+        self._router_inst = _router
+        self._router_lock = threading.Lock()
+
+    @property
+    def _router(self) -> Router:
+        # created lazily so handles pickle cleanly into replicas (the
+        # router holds live threads; each process builds its own)
+        with self._router_lock:
+            if self._router_inst is None:
+                self._router_inst = Router(self._controller, self._name)
+            return self._router_inst
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign(self._method, args, kwargs)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_") or name in ("remote",):
+            raise AttributeError(name)
+        return DeploymentHandle(
+            self._controller, self._name, method=name,
+            _router=self._router_inst)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._controller, self._name,
+                                   self._method))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._name!r}, method={self._method!r})"

@@ -1,0 +1,109 @@
+"""HTTP ingress proxy.
+
+The reference runs an HTTP proxy per node (serve/_private/http_proxy.py:189,
+333) routing ``/<deployment>`` to replicas. Here a single proxy actor runs
+a stdlib ThreadingHTTPServer (no aiohttp dependency): request bodies are
+passed as the deployment's argument, JSON bodies decoded, responses
+JSON-encoded. Enough surface for curl/load-balancer ingress; Python-side
+traffic should prefer handles (zero-copy through the object plane).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict
+
+from .. import api as core_api
+
+PROXY_NAME = "SERVE_HTTP_PROXY"
+
+
+class HTTPProxy:
+    def __init__(self, controller, port: int):
+        self._controller = controller
+        self._port = port
+        self._handles: Dict[str, object] = {}
+        self._server = None
+        self._thread = None
+
+    def ready(self) -> int:
+        if self._server is not None:  # idempotent: already listening
+            return self._port
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                name = self.path.strip("/").split("/")[0]
+                if not name:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no deployment in path"}')
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                arg = None
+                if body:
+                    try:
+                        arg = json.loads(body)
+                    except json.JSONDecodeError:
+                        arg = body.decode("utf-8", "replace")
+                try:
+                    handle = proxy._handle_for(name)
+                    ref = handle.remote(arg) if arg is not None \
+                        else handle.remote()
+                    result = core_api.get(ref, timeout=60)
+                    payload = json.dumps(result).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(
+                        json.dumps({"error": str(e)}).encode())
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http")
+        self._thread.start()
+        return self._port
+
+    def _handle_for(self, name: str):
+        from .handle import DeploymentHandle
+
+        h = self._handles.get(name)
+        if h is None:
+            h = DeploymentHandle(self._controller, name)
+            self._handles[name] = h
+        return h
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+
+
+def start_proxy(controller, port: int) -> int:
+    """Start (or reuse) the proxy actor; returns the bound port."""
+    try:
+        proxy = core_api.get_actor(PROXY_NAME)
+    except Exception:
+        try:
+            proxy = core_api.remote(HTTPProxy).options(
+                name=PROXY_NAME, lifetime="detached", num_cpus=0,
+                max_concurrency=32,
+            ).remote(controller, port)
+        except Exception:
+            proxy = core_api.get_actor(PROXY_NAME)
+    return core_api.get(proxy.ready.remote(), timeout=60)

@@ -154,12 +154,14 @@ class Histogram(Metric):
     upper bounds of the finite buckets; +Inf is implicit."""
 
     def __init__(self, name, description="", boundaries=None, tag_keys=None):
-        super().__init__(name, description, tag_keys)
+        # validate BEFORE registering: a raise after registration would
+        # leave a half-constructed metric in the global registry
         if not boundaries:
             raise ValueError("Histogram requires non-empty boundaries")
         bs = list(boundaries)
         if bs != sorted(bs) or any(b <= 0 for b in bs):
             raise ValueError("boundaries must be positive and ascending")
+        super().__init__(name, description, tag_keys)
         self._boundaries = bs
         self._counts: Dict[TagKey, List[int]] = {}
         self._sums: Dict[TagKey, float] = {}

@@ -29,19 +29,29 @@ class _QueueActor:
     def __init__(self, maxsize: int = 0):
         self.maxsize = maxsize
         self.queue = asyncio.Queue(maxsize)
+        self._inflight = 0  # blocked put/get coroutines (for graceful stop)
 
     async def put(self, item, timeout: Optional[float] = None) -> bool:
+        self._inflight += 1
         try:
             await asyncio.wait_for(self.queue.put(item), timeout)
             return True
         except asyncio.TimeoutError:
             return False
+        finally:
+            self._inflight -= 1
 
     async def get(self, timeout: Optional[float] = None):
+        self._inflight += 1
         try:
             return True, await asyncio.wait_for(self.queue.get(), timeout)
         except asyncio.TimeoutError:
             return False, None
+        finally:
+            self._inflight -= 1
+
+    async def num_inflight(self) -> int:
+        return self._inflight
 
     # every method is async so all queue mutations happen on the actor's
     # event loop — asyncio.Queue is not thread-safe, and sync methods would
@@ -84,7 +94,10 @@ class _QueueActor:
 class Queue:
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
-        opts.setdefault("max_concurrency", 16)
+        # async-actor concurrency default (the reference allows 1000
+        # concurrent coroutines on async actors); blocked getters/putters
+        # park on the actor loop, each holding one concurrency slot
+        opts.setdefault("max_concurrency", 1000)
         opts.setdefault("num_cpus", 0)
         self.maxsize = maxsize
         self.actor = api.remote(_QueueActor).options(**opts).remote(maxsize)
@@ -146,9 +159,26 @@ class Queue:
             raise Empty
         return items
 
-    def shutdown(self, force: bool = False) -> None:
-        if self.actor is not None:
-            api.kill(self.actor)
+    def shutdown(self, force: bool = False,
+                 grace_period_s: float = 5.0) -> None:
+        """Kill the queue actor. force=False first waits (up to
+        grace_period_s) for blocked put/get calls to finish, mirroring the
+        reference's graceful Queue.shutdown."""
+        if self.actor is None:
+            return
+        if not force:
+            import time
+
+            deadline = time.monotonic() + grace_period_s
+            while time.monotonic() < deadline:
+                try:
+                    if api.get(self.actor.num_inflight.remote(),
+                               timeout=5) == 0:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.05)
+        api.kill(self.actor)
         self.actor = None
 
 

@@ -122,9 +122,10 @@ class TestQueue:
             q.get_nowait_batch(5)
 
     def test_many_blocked_getters(self, rmt_start_regular):
-        """Blocked async gets park on the actor loop, not executor threads,
-        so more blocked getters than max_concurrency can't deadlock puts."""
-        q = Queue(actor_options={"max_concurrency": 2})
+        """Blocked async gets park on the actor loop under the 1000-slot
+        async concurrency cap, not on executor threads — many blocked
+        getters coexist with later puts."""
+        q = Queue()
 
         @rmt.remote
         def getter(queue):
@@ -135,6 +136,7 @@ class TestQueue:
         for i in range(5):
             q.put(i)
         assert sorted(rmt.get(refs)) == [0, 1, 2, 3, 4]
+        q.shutdown()  # graceful: no blocked calls remain
 
     def test_queue_passed_to_task(self, rmt_start_regular):
         q = Queue()
