@@ -1,0 +1,14 @@
+"""RLlib-slim: RL algorithms with CPU rollout actors + a jax learner.
+
+The reference's RLlib (python/ray/rllib/ — Algorithm/AlgorithmConfig,
+rollout workers, PPO/IMPALA, replay buffers), rebuilt TPU-first: the
+learner update is one jit'd XLA program, rollouts are CPU actors, and
+weights broadcast through the object store.
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .env import CartPole, Env, make_env, register_env  # noqa: F401
+from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
+from .rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

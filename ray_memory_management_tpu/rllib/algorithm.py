@@ -1,0 +1,199 @@
+"""Algorithm base + config builder.
+
+The reference's Algorithm(Trainable) (rllib/algorithms/algorithm.py:145,
+step:631, training_step:1154) and AlgorithmConfig builder
+(algorithm_config.py: .environment()/.rollouts()/.training()/.resources()).
+Algorithms implement ``training_step``; the Trainable contract
+(train/save/restore) comes from the tune library, so any algorithm drops
+straight into the Tuner.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional, Sequence, Type
+
+import numpy as np
+
+from ..tune.trainable import Trainable
+from .env import make_env
+from .models import ac_init, params_from_numpy, params_to_numpy
+from .rollout_worker import RolloutWorker, WorkerSet
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env_spec: Any = "CartPole"
+        self.env_config: Dict[str, Any] = {}
+        self.num_rollout_workers = 2
+        self.rollout_fragment_length = 200
+        self.train_batch_size = 4000
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.seed = 0
+        self.hidden: Sequence[int] = (64, 64)
+        self.extra: Dict[str, Any] = {}
+
+    # builder surface (each returns self, like the reference)
+    def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env_spec = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 rollout_fragment_length=None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, train_batch_size=None,
+                 model=None, **extra) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model is not None and "fcnet_hiddens" in model:
+            self.hidden = tuple(model["fcnet_hiddens"])
+        self.extra.update(extra)
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "env_spec": self.env_spec,
+            "env_config": self.env_config,
+            "num_rollout_workers": self.num_rollout_workers,
+            "rollout_fragment_length": self.rollout_fragment_length,
+            "train_batch_size": self.train_batch_size,
+            "lr": self.lr,
+            "gamma": self.gamma,
+            "seed": self.seed,
+            "hidden": tuple(self.hidden),
+            **self.extra,
+        }
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algorithm class")
+        return self.algo_class(config=self.to_dict())
+
+
+class Algorithm(Trainable):
+    """Common setup: local policy params + remote rollout workers.
+    Subclasses implement ``training_step`` returning metrics."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.np_rng = np.random.default_rng(seed)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        self.obs_dim = probe_env.observation_dim
+        self.num_actions = probe_env.num_actions
+        self.params = ac_init(
+            jax.random.key(seed), self.obs_dim, self.num_actions,
+            config.get("hidden", (64, 64)))
+        self.workers: Optional[WorkerSet] = None
+        self.local_worker: Optional[RolloutWorker] = None
+        gamma = config.get("gamma", 0.99)
+        lam = config.get("lambda_", 0.95)
+        if config.get("num_rollout_workers", 0) > 0:
+            self.workers = WorkerSet(
+                config["env_spec"], config.get("env_config"),
+                config.get("hidden", (64, 64)),
+                config["num_rollout_workers"], seed, gamma, lam)
+        else:
+            self.local_worker = RolloutWorker(
+                config["env_spec"], config.get("env_config"),
+                config.get("hidden", (64, 64)), seed, gamma, lam)
+        self._timesteps_total = 0
+
+    # -- subclass hook ---------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        result.setdefault("timesteps_total", self._timesteps_total)
+        result.update(self._episode_metrics())
+        return result
+
+    def _episode_metrics(self) -> Dict[str, Any]:
+        if self.workers is not None:
+            stats = self.workers.stats()
+        else:
+            stats = [self.local_worker.episode_stats()]
+        rewards = [s["episode_reward_mean"] for s in stats
+                   if s["episode_reward_mean"] is not None]
+        lengths = [s["episode_len_mean"] for s in stats
+                   if s["episode_len_mean"] is not None]
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else None,
+            "episode_len_mean": float(np.mean(lengths)) if lengths else None,
+            "episodes_total": sum(s["episodes"] for s in stats),
+        }
+
+    # -- weights ---------------------------------------------------------------
+    def get_weights(self):
+        return params_to_numpy(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = params_from_numpy(weights)
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        """Greedy action for inference/eval (Algorithm.compute_single_action
+        in the reference)."""
+        from .models import ac_apply
+
+        import jax.numpy as jnp
+
+        logits, _ = ac_apply(self.params, jnp.asarray(obs)[None, :])
+        return int(np.argmax(np.asarray(logits)[0]))
+
+    # -- checkpointing ---------------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
+            pickle.dump({
+                "weights": self.get_weights(),
+                "timesteps_total": self._timesteps_total,
+                "extra": self._save_extra_state(),
+            }, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.set_weights(state["weights"])
+        self._timesteps_total = state["timesteps_total"]
+        self._load_extra_state(state.get("extra"))
+        self._sync_weights()
+
+    def _save_extra_state(self) -> Any:
+        return None
+
+    def _load_extra_state(self, state: Any) -> None:
+        pass
+
+    def _sync_weights(self) -> None:
+        weights = self.get_weights()
+        if self.workers is not None:
+            self.workers.set_weights(weights)
+        else:
+            self.local_worker.set_weights(weights)
+
+    def cleanup(self) -> None:
+        if self.workers is not None:
+            self.workers.stop()

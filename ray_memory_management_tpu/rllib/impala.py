@@ -1,0 +1,158 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+The reference's IMPALA (rllib/algorithms/impala/impala.py:350-388 wires
+async sample requests into learner threads; V-trace from the paper).
+Workers sample continuously; the learner consumes fragments as they
+arrive (api.wait on in-flight refs), corrects the off-policyness with
+V-trace, applies one SGD step per fragment, and immediately re-arms the
+worker with fresh weights — sampling and learning overlap instead of the
+PPO sync barrier.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .models import ac_apply
+
+
+def make_impala_update(optimizer, gamma: float, vf_coeff: float,
+                       entropy_coeff: float, rho_clip: float = 1.0,
+                       c_clip: float = 1.0):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def vtrace(target_logp, behavior_logp, rewards, values, dones,
+               bootstrap_value):
+        """V-trace targets (Espeholt et al. 2018) via a reverse scan."""
+        rho = jnp.exp(target_logp - behavior_logp)
+        clipped_rho = jnp.minimum(rho, rho_clip)
+        cs = jnp.minimum(rho, c_clip)
+        discounts = gamma * (1.0 - dones)
+        next_values = jnp.concatenate(
+            [values[1:], jnp.array([bootstrap_value])])
+        deltas = clipped_rho * (rewards + discounts * next_values - values)
+
+        def scan_fn(acc, xs):
+            delta, discount, c = xs
+            acc = delta + discount * c * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn, jnp.float32(0.0), (deltas, discounts, cs),
+            reverse=True)
+        vs = values + vs_minus_v
+        next_vs = jnp.concatenate([vs[1:], jnp.array([bootstrap_value])])
+        pg_adv = clipped_rho * (rewards + discounts * next_vs - values)
+        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+    def loss_fn(params, obs, actions, behavior_logp, rewards, dones,
+                bootstrap_value):
+        logits, values = ac_apply(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+        vs, pg_adv = vtrace(target_logp, behavior_logp, rewards, values,
+                            dones, bootstrap_value)
+        pg_loss = -(target_logp * pg_adv).mean()
+        vf_loss = jnp.square(values - vs).mean()
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, behavior_logp, rewards,
+               dones, bootstrap_value):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, behavior_logp, rewards, dones,
+            bootstrap_value)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["total_loss"] = loss
+        return params, opt_state, stats
+
+    return update
+
+
+class IMPALA(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import optax
+
+        if config.get("num_rollout_workers", 0) < 1:
+            config = dict(config)
+            config["num_rollout_workers"] = 1  # async needs remote samplers
+        super().setup(config)
+        self.optimizer = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_impala_update(
+            self.optimizer, config.get("gamma", 0.99),
+            config.get("vf_loss_coeff", 0.5),
+            config.get("entropy_coeff", 0.01))
+        self._inflight: Dict[Any, Any] = {}  # sample ref -> worker
+
+    def _arm(self, worker) -> None:
+        """Send fresh weights then request the next fragment."""
+        worker.set_weights.remote(api.put(self.get_weights()))
+        ref = worker.sample.remote(
+            self.cfg.get("rollout_fragment_length", 200))
+        self._inflight[ref] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        target = self.cfg.get("train_batch_size", 4000)
+        processed = 0
+        stats: Dict[str, Any] = {}
+        if not self._inflight:
+            for w in self.workers.remote_workers:
+                self._arm(w)
+        while processed < target:
+            ready, _ = api.wait(
+                list(self._inflight), num_returns=1, timeout=60)
+            if not ready:
+                raise TimeoutError("no sample fragments arriving")
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            batch = api.get(ref)
+            self._arm(worker)  # overlap: next fragment samples while we learn
+            n = sb.batch_size(batch)
+            processed += n
+            self._timesteps_total += n
+            # V(s_T) computed by the worker after the fragment's last step
+            bootstrap = float(batch[sb.BOOTSTRAP][0])
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(batch[sb.OBS]),
+                jnp.asarray(batch[sb.ACTIONS]),
+                jnp.asarray(batch[sb.LOGP]),
+                jnp.asarray(batch[sb.REWARDS]),
+                jnp.asarray(batch[sb.DONES]),
+                jnp.float32(bootstrap),
+            )
+        out = {k: float(v) for k, v in stats.items()}
+        wall = time.time() - t0
+        out.update({
+            "num_env_steps_sampled": processed,
+            "steps_per_s": processed / max(wall, 1e-9),
+        })
+        return out
+
+    def cleanup(self) -> None:
+        self._inflight.clear()
+        super().cleanup()
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(IMPALA)
+        self.num_rollout_workers = 2
+        self.extra.update({"vf_loss_coeff": 0.5, "entropy_coeff": 0.01})

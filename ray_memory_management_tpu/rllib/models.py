@@ -1,0 +1,74 @@
+"""Policy/value networks as pure jax functions.
+
+The reference's model catalog builds torch/tf nets (rllib/models/catalog.py,
+with a small models/jax/ tree); here nets are jax pytrees + pure apply
+functions (module-level, so they pickle by reference into rollout actors).
+MLPs batch cleanly onto the MXU; bigger models plug in by passing custom
+init/apply callables through the config.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(rng: jax.Array, sizes: Sequence[int]) -> List[Dict[str, Any]]:
+    """Orthogonal-ish (scaled normal) init for a relu MLP."""
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fan_in, fan_out)) * jnp.sqrt(2.0 / fan_in)
+        params.append({"w": w, "b": jnp.zeros((fan_out,))})
+    return params
+
+
+def mlp_apply(params: List[Dict[str, Any]], x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def ac_init(rng: jax.Array, obs_dim: int, num_actions: int,
+            hidden: Sequence[int] = (64, 64)) -> Dict[str, Any]:
+    """Separate policy and value towers (the reference's default
+    fcnet_hiddens=[256,256] shape, scaled down)."""
+    k_pi, k_vf = jax.random.split(rng)
+    return {
+        "pi": mlp_init(k_pi, [obs_dim, *hidden, num_actions]),
+        "vf": mlp_init(k_vf, [obs_dim, *hidden, 1]),
+    }
+
+
+def ac_apply(params: Dict[str, Any],
+             obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B, A], values [B])."""
+    logits = mlp_apply(params["pi"], obs)
+    values = mlp_apply(params["vf"], obs)[..., 0]
+    return logits, values
+
+
+@jax.jit
+def sample_actions(params: Dict[str, Any], obs: jnp.ndarray,
+                   rng: jax.Array):
+    """Sample actions + logp + value for a batch of observations (the
+    rollout hot path; jit so repeated sampling reuses the compiled fn)."""
+    logits, values = ac_apply(params, obs)
+    actions = jax.random.categorical(rng, logits)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, actions[:, None], axis=-1)[:, 0]
+    return actions, logp, values
+
+
+def params_to_numpy(params) -> Any:
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+def params_from_numpy(params) -> Any:
+    return jax.tree_util.tree_map(jnp.asarray, params)

@@ -1,0 +1,159 @@
+"""PPO: clipped-surrogate policy optimization with a jax learner.
+
+The reference's PPO (rllib/algorithms/ppo/ppo.py:289,401): synchronous
+sampling from rollout workers, GAE postprocessing (done worker-side here),
+then ``num_sgd_iter`` epochs of minibatch SGD. The update is one jit'd
+function — on TPU the whole minibatch step (forward, backward, Adam) is a
+single XLA program on the MXU; rollouts stay on CPU actors.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .models import ac_apply
+
+
+def make_ppo_update(optimizer, clip_param: float, vf_coeff: float,
+                    entropy_coeff: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, obs, actions, old_logp, advantages, targets):
+        logits, values = ac_apply(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - old_logp)
+        adv = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+        pg_loss = -surrogate.mean()
+        vf_loss = jnp.square(values - targets).mean()
+        entropy = -jnp.sum(
+            jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {
+            "policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy,
+            "kl": (old_logp - logp).mean(),
+        }
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, old_logp, advantages,
+               targets):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, old_logp, advantages, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["total_loss"] = loss
+        return params, opt_state, stats
+
+    return update
+
+
+class PPO(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import optax
+
+        super().setup(config)
+        self.clip_param = config.get("clip_param", 0.2)
+        self.vf_coeff = config.get("vf_loss_coeff", 0.5)
+        self.entropy_coeff = config.get("entropy_coeff", 0.01)
+        self.num_sgd_iter = config.get("num_sgd_iter", 6)
+        self.sgd_minibatch_size = config.get("sgd_minibatch_size", 128)
+        self.optimizer = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_ppo_update(
+            self.optimizer, self.clip_param, self.vf_coeff,
+            self.entropy_coeff)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        fragment = self.cfg.get("rollout_fragment_length", 200)
+        target = self.cfg.get("train_batch_size", 4000)
+
+        # 1. broadcast current weights, sample synchronously
+        batches = []
+        if self.workers is not None:
+            self._sync_weights()
+            while sum(sb.batch_size(b) for b in batches) < target:
+                refs = self.workers.sample(fragment)
+                batches.extend(api.get(refs))
+        else:
+            self.local_worker.set_weights(self.get_weights())
+            while sum(sb.batch_size(b) for b in batches) < target:
+                batches.append(self.local_worker.sample(fragment))
+        batch = sb.concat_batches(batches)
+        n = sb.batch_size(batch)
+        self._timesteps_total += n
+        sample_time = time.time() - t0
+
+        # 2. minibatch SGD epochs on the learner device
+        t1 = time.time()
+        obs = jnp.asarray(batch[sb.OBS])
+        actions = jnp.asarray(batch[sb.ACTIONS])
+        old_logp = jnp.asarray(batch[sb.LOGP])
+        advantages = jnp.asarray(batch[sb.ADVANTAGES])
+        targets = jnp.asarray(batch[sb.TARGETS])
+        stats = {}
+        mb = min(self.sgd_minibatch_size, n)
+        for _epoch in range(self.num_sgd_iter):
+            for idx in sb.minibatch_indices(n, mb, self.np_rng):
+                i = jnp.asarray(idx)
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.opt_state, obs[i], actions[i],
+                    old_logp[i], advantages[i], targets[i])
+        learn_time = time.time() - t1
+
+        out = {k: float(v) for k, v in stats.items()}
+        out.update({
+            "num_env_steps_sampled": n,
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            "steps_per_s": n / max(sample_time + learn_time, 1e-9),
+        })
+        return out
+
+    def _save_extra_state(self):
+        from .models import params_to_numpy
+
+        return {"opt_state": params_to_numpy(self.opt_state)}
+
+    def _load_extra_state(self, state) -> None:
+        if state and "opt_state" in state:
+            from .models import params_from_numpy
+
+            self.opt_state = params_from_numpy(state["opt_state"])
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PPO)
+        self.extra.update({
+            "clip_param": 0.2, "vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+            "num_sgd_iter": 6, "sgd_minibatch_size": 128,
+        })
+
+    def training(self, *, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, num_sgd_iter=None,
+                 sgd_minibatch_size=None, **kwargs) -> "PPOConfig":
+        super().training(**kwargs)
+        for k, v in (("clip_param", clip_param),
+                     ("vf_loss_coeff", vf_loss_coeff),
+                     ("entropy_coeff", entropy_coeff),
+                     ("num_sgd_iter", num_sgd_iter),
+                     ("sgd_minibatch_size", sgd_minibatch_size)):
+            if v is not None:
+                self.extra[k] = v
+        return self

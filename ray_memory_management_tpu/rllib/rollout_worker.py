@@ -1,0 +1,159 @@
+"""Rollout workers: CPU actors stepping envs with the current policy.
+
+The reference's RolloutWorker (rllib/evaluation/rollout_worker.py:124) +
+WorkerSet (worker_set.py:50): the algorithm broadcasts weights, workers
+sample fixed-length fragments and return batches through the object
+store. Workers force jax onto CPU — chips belong to the learner (the
+reference's sampler/learner split).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .env import make_env
+from .models import ac_init, params_from_numpy, params_to_numpy, sample_actions
+
+
+class RolloutWorker:
+    def __init__(self, env_spec, env_config: Optional[dict],
+                 hidden, seed: int, gamma: float = 0.99,
+                 lam: float = 0.95):
+        import jax
+
+        from .. import _worker_context
+
+        # Rollouts never touch the TPU — but only pin the process-global
+        # default device when this IS a dedicated worker process; in
+        # local mode (num_rollout_workers=0) the learner shares the
+        # process and must keep its accelerator.
+        if _worker_context.in_worker():
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        self.env = make_env(env_spec, env_config)
+        self.gamma = gamma
+        self.lam = lam
+        self.rng = np.random.default_rng(seed)
+        self._jax_key = jax.random.key(seed)
+        self.params = ac_init(
+            jax.random.key(0), self.env.observation_dim,
+            self.env.num_actions, hidden)
+        self._obs = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self.episode_rewards: List[float] = []
+        self.episode_lengths: List[int] = []
+
+    def ready(self) -> str:
+        return "ok"
+
+    def set_weights(self, weights) -> None:
+        self.params = params_from_numpy(weights)
+
+    def get_weights(self):
+        return params_to_numpy(self.params)
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect one fragment of ``num_steps`` transitions (the
+        rollout_fragment_length contract; sampler.py SyncSampler)."""
+        import jax
+
+        obs_buf = np.zeros(
+            (num_steps, self.env.observation_dim), dtype=np.float32)
+        act_buf = np.zeros(num_steps, dtype=np.int32)
+        rew_buf = np.zeros(num_steps, dtype=np.float32)
+        done_buf = np.zeros(num_steps, dtype=np.float32)
+        logp_buf = np.zeros(num_steps, dtype=np.float32)
+        val_buf = np.zeros(num_steps, dtype=np.float32)
+
+        for t in range(num_steps):
+            self._jax_key, sub = jax.random.split(self._jax_key)
+            action, logp, value = sample_actions(
+                self.params, self._obs[None, :], sub)
+            a = int(action[0])
+            obs_buf[t] = self._obs
+            act_buf[t] = a
+            logp_buf[t] = float(logp[0])
+            val_buf[t] = float(value[0])
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            rew_buf[t] = reward
+            done_buf[t] = float(terminated)
+            self._episode_reward += reward
+            self._episode_len += 1
+            if truncated and not terminated:
+                # time-limit truncation is not a true terminal: fold the
+                # bootstrap V(s_next) into the reward BEFORE the reset
+                # replaces next_obs, then cut the trace (done=1) so GAE /
+                # V-trace never discount across the episode boundary
+                self._jax_key, sub = jax.random.split(self._jax_key)
+                _, _, v_next = sample_actions(
+                    self.params, next_obs[None, :], sub)
+                rew_buf[t] += self.gamma * float(v_next[0])
+                done_buf[t] = 1.0
+            if terminated or truncated:
+                self.episode_rewards.append(self._episode_reward)
+                self.episode_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                next_obs = self.env.reset(
+                    seed=int(self.rng.integers(1 << 31)))
+            self._obs = next_obs
+
+        # bootstrap value for a fragment ending mid-episode
+        self._jax_key, sub = jax.random.split(self._jax_key)
+        _, _, last_val = sample_actions(self.params, self._obs[None, :], sub)
+        bootstrap = float(last_val[0])
+        adv, targets = sb.compute_gae(
+            rew_buf, val_buf, done_buf, bootstrap,
+            gamma=self.gamma, lam=self.lam)
+        return {
+            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
+            sb.DONES: done_buf, sb.LOGP: logp_buf, sb.VALUES: val_buf,
+            sb.ADVANTAGES: adv, sb.TARGETS: targets,
+            sb.BOOTSTRAP: np.array([bootstrap], dtype=np.float32),
+        }
+
+    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
+        rewards = self.episode_rewards[-window:]
+        lengths = self.episode_lengths[-window:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else None,
+            "episode_len_mean": float(np.mean(lengths)) if lengths else None,
+        }
+
+
+class WorkerSet:
+    """Remote rollout workers + broadcast/gather helpers
+    (worker_set.py:50)."""
+
+    def __init__(self, env_spec, env_config, hidden, num_workers: int,
+                 seed: int, gamma: float = 0.99, lam: float = 0.95):
+        cls = api.remote(RolloutWorker)
+        self.remote_workers = [
+            cls.options(num_cpus=1).remote(
+                env_spec, env_config, hidden, seed + 1000 * (i + 1),
+                gamma, lam)
+            for i in range(num_workers)
+        ]
+        api.get([w.ready.remote() for w in self.remote_workers])
+
+    def set_weights(self, weights) -> None:
+        # one put, many readers: the broadcast rides the object store
+        ref = api.put(weights)
+        api.get([w.set_weights.remote(ref) for w in self.remote_workers])
+
+    def sample(self, num_steps: int) -> List:
+        return [w.sample.remote(num_steps) for w in self.remote_workers]
+
+    def stats(self) -> List[Dict[str, Any]]:
+        return api.get(
+            [w.episode_stats.remote() for w in self.remote_workers])
+
+    def stop(self) -> None:
+        for w in self.remote_workers:
+            api.kill(w)
