@@ -1,0 +1,166 @@
+"""Autoscaler: reconcile node count against resource demand.
+
+The reference's StandardAutoscaler.update loop (autoscaler/_private/
+autoscaler.py:154,345) driven by the Monitor (monitor.py:125,333) reading
+load from GCS, with pluggable NodeProviders (AWS/GCP/.../fake_multi_node).
+Here: demand = tasks the scheduler could not place (the runtime's pending
+queue) plus per-node queue backlog; the provider contract is create/
+terminate; ``VirtualNodeProvider`` adds in-process nodes (the
+fake_multi_node analog used for tests), and a TPU-pod provider slots in
+by implementing the same two methods over real hosts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import _worker_context
+
+
+class NodeProvider:
+    """Provider contract (autoscaler/node_provider.py): create/terminate
+    nodes and enumerate the ones this autoscaler manages."""
+
+    def create_node(self, node_config: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: Any) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class VirtualNodeProvider(NodeProvider):
+    """Adds/removes virtual nodes on the in-process runtime — the
+    fake_multi_node provider analog for tests and laptops."""
+
+    def __init__(self, runtime=None):
+        self._rt = runtime or _worker_context.get_runtime()
+        self._managed: List[Any] = []
+
+    def create_node(self, node_config: Dict[str, Any]) -> Any:
+        node_id = self._rt.add_node(dict(node_config))
+        self._managed.append(node_id)
+        return node_id
+
+    def terminate_node(self, node_id: Any) -> None:
+        if node_id in self._managed:
+            self._managed.remove(node_id)
+        self._rt.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> List[Any]:
+        return [n for n in self._managed
+                if self._rt.nodes.get(n) and self._rt.nodes[n].alive]
+
+
+class StandardAutoscaler:
+    """One reconciliation pass per ``update()`` (autoscaler.py:345):
+    scale up while unplaceable demand exists and below max_workers;
+    scale down nodes idle longer than idle_timeout_s."""
+
+    def __init__(self, provider: NodeProvider,
+                 node_config: Optional[Dict[str, Any]] = None,
+                 min_workers: int = 0, max_workers: int = 4,
+                 idle_timeout_s: float = 30.0,
+                 upscaling_speed: float = 1.0,
+                 runtime=None):
+        self.provider = provider
+        self.node_config = dict(node_config or {"num_cpus": 4})
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.upscaling_speed = max(upscaling_speed, 0.1)
+        self._rt = runtime or _worker_context.get_runtime()
+        self._idle_since: Dict[Any, float] = {}
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- demand signals -------------------------------------------------------
+    def pending_demand(self) -> int:
+        """Tasks with nowhere to go right now (the load-metrics 'pending'
+        the reference monitor reads from GCS)."""
+        rt = self._rt
+        with rt._lock:  # nodes dict mutates under this same lock
+            pending = len(rt._pending_schedule)
+            node_managers = list(rt.nodes.values())
+        backlog = sum(len(nm.queue) for nm in node_managers if nm.alive)
+        return pending + backlog
+
+    def _node_busy(self, node_id) -> bool:
+        nm = self._rt.nodes.get(node_id)
+        if nm is None or not nm.alive:
+            return False
+        if nm.queue:
+            return True
+        return any(h.inflight or h.actor_id is not None
+                   for h in nm.workers.values())
+
+    # -- reconciliation -------------------------------------------------------
+    def update(self) -> None:
+        managed = self.provider.non_terminated_nodes()
+        demand = self.pending_demand()
+
+        # scale up: below min, or unplaceable work exists
+        want = 0
+        if len(managed) < self.min_workers:
+            want = self.min_workers - len(managed)
+        elif demand > 0 and len(managed) < self.max_workers:
+            want = max(1, int(len(managed) * self.upscaling_speed) or 1)
+        want = min(want, self.max_workers - len(managed))
+        for _ in range(max(want, 0)):
+            self.provider.create_node(self.node_config)
+            self.num_launches += 1
+
+        # scale down: idle past the timeout, but never below min_workers
+        now = time.monotonic()
+        managed = self.provider.non_terminated_nodes()
+        for node_id in list(managed):
+            if self._node_busy(node_id):
+                self._idle_since.pop(node_id, None)
+                continue
+            since = self._idle_since.setdefault(node_id, now)
+            if (now - since >= self.idle_timeout_s
+                    and len(self.provider.non_terminated_nodes())
+                    > self.min_workers):
+                self.provider.terminate_node(node_id)
+                self._idle_since.pop(node_id, None)
+                self.num_terminations += 1
+
+
+class Monitor:
+    """Background loop driving the autoscaler (monitor.py:333)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 update_interval_s: float = 1.0):
+        self.autoscaler = autoscaler
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rmt-autoscaler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                # keep reconciling, but a failing provider must be visible
+                log.exception("autoscaler update failed")
+            self._stop.wait(self.update_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

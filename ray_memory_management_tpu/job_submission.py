@@ -1,0 +1,194 @@
+"""Job submission: run driver scripts as managed subprocesses.
+
+The reference's job manager + SDK (dashboard/modules/job/job_manager.py,
+python/ray/job_submission/): submit an entrypoint command, track status,
+stream logs, stop. No REST head here — the client manages jobs directly,
+with state durable in a filesystem job dir so a second client (or CLI)
+can list/inspect the same jobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+_DEFAULT_DIR = os.path.join(tempfile.gettempdir(), "rmt_jobs")
+
+
+class JobSubmissionClient:
+    def __init__(self, job_dir: Optional[str] = None):
+        self.job_dir = job_dir or os.environ.get(
+            "RMT_JOB_DIR", _DEFAULT_DIR)
+        os.makedirs(self.job_dir, exist_ok=True)
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    # -- paths ----------------------------------------------------------------
+    def _meta_path(self, job_id: str) -> str:
+        return os.path.join(self.job_dir, job_id, "meta.json")
+
+    def _log_path(self, job_id: str) -> str:
+        return os.path.join(self.job_dir, job_id, "driver.log")
+
+    def _write_meta(self, job_id: str, meta: Dict[str, Any]) -> None:
+        path = self._meta_path(job_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def _read_meta(self, job_id: str) -> Dict[str, Any]:
+        with open(self._meta_path(job_id)) as f:
+            return json.load(f)
+
+    # -- API ------------------------------------------------------------------
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   submission_id: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        """Launch the entrypoint as a detached subprocess; returns the
+        job id (JobSubmissionClient.submit_job in the reference)."""
+        if not entrypoint or not entrypoint.strip():
+            raise ValueError("entrypoint must be a non-empty command")
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        job_root = os.path.join(self.job_dir, job_id)
+        if os.path.exists(job_root):
+            raise ValueError(f"job {job_id!r} already exists")
+        os.makedirs(job_root)
+        env = dict(os.environ)
+        renv = runtime_env or {}
+        env.update({str(k): str(v)
+                    for k, v in (renv.get("env_vars") or {}).items()})
+        cwd = renv.get("working_dir") or os.getcwd()
+        log = open(self._log_path(job_id), "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, cwd=cwd, env=env,
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,  # survives this client; killable by pgid
+        )
+        log.close()
+        self._procs[job_id] = proc
+        self._write_meta(job_id, {
+            "job_id": job_id,
+            "entrypoint": entrypoint,
+            "status": RUNNING,
+            "pid": proc.pid,
+            "start_time": time.time(),
+            "end_time": None,
+            "metadata": metadata or {},
+        })
+        return job_id
+
+    def _refresh(self, job_id: str) -> Dict[str, Any]:
+        meta = self._read_meta(job_id)
+        if meta["status"] != RUNNING:
+            return meta
+        proc = self._procs.get(job_id)
+        if proc is not None:
+            code = proc.poll()
+            if code is None:
+                return meta
+            meta["status"] = SUCCEEDED if code == 0 else FAILED
+            meta["returncode"] = code
+        else:
+            # job started by another client: liveness via kill(pid, 0).
+            # EPERM means SOME process has the pid (possibly a reuse by
+            # another user) — treat as running rather than crash.
+            try:
+                os.kill(meta["pid"], 0)
+                return meta
+            except PermissionError:
+                return meta
+            except ProcessLookupError:
+                meta["status"] = FAILED
+                meta.setdefault("returncode", None)
+        meta["end_time"] = time.time()
+        self._write_meta(job_id, meta)
+        return meta
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._refresh(job_id)["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        return self._refresh(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        try:
+            with open(self._log_path(job_id), "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        jobs = []
+        for job_id in sorted(os.listdir(self.job_dir)):
+            if os.path.exists(self._meta_path(job_id)):
+                jobs.append(self._refresh(job_id))
+        return jobs
+
+    def stop_job(self, job_id: str) -> bool:
+        meta = self._refresh(job_id)
+        if meta["status"] != RUNNING:
+            return False
+        try:
+            os.killpg(os.getpgid(meta["pid"]), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if self._refresh(job_id)["status"] != RUNNING:
+                break
+            time.sleep(0.1)
+        meta = self._refresh(job_id)
+        if meta["status"] == RUNNING:
+            try:
+                os.killpg(os.getpgid(meta["pid"]), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            meta["status"] = STOPPED
+            meta["end_time"] = time.time()
+            self._write_meta(job_id, meta)
+        elif meta["status"] in (FAILED, SUCCEEDED):
+            # terminated by our signal: record the stop intent
+            meta["status"] = STOPPED
+            self._write_meta(job_id, meta)
+        return True
+
+    def delete_job(self, job_id: str) -> None:
+        import shutil
+
+        if self.get_job_status(job_id) == RUNNING:
+            raise ValueError("stop the job before deleting it")
+        shutil.rmtree(os.path.join(self.job_dir, job_id),
+                      ignore_errors=True)
+
+    def tail_job_logs(self, job_id: str, timeout_s: float = 30.0):
+        """Generator yielding log chunks until the job finishes."""
+        path = self._log_path(job_id)
+        pos = 0
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            try:
+                with open(path, "r", errors="replace") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+            except FileNotFoundError:
+                chunk = ""
+            if chunk:
+                yield chunk
+            if status != RUNNING:
+                return
+            time.sleep(0.2)

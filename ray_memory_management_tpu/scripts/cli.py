@@ -1,0 +1,214 @@
+"""``rmt`` CLI.
+
+The reference's ``ray`` click CLI (python/ray/scripts/scripts.py:
+status:1865, memory:1823, timeline:1758, microbenchmark:1744, plus the
+job and workflow CLIs). argparse-based (no extra deps); subcommands that
+need a cluster spin up an ephemeral in-process one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _ephemeral_runtime(num_nodes: int = 1):
+    import ray_memory_management_tpu as rmt
+
+    return rmt.init(num_nodes=num_nodes, ignore_reinit_error=True)
+
+
+def cmd_status(args) -> int:
+    import ray_memory_management_tpu as rmt
+
+    _ephemeral_runtime(args.num_nodes)
+    total = rmt.cluster_resources()
+    avail = rmt.available_resources()
+    print("======== Cluster status ========")
+    print(f"Nodes: {len(rmt.nodes())}")
+    print("Resources")
+    print("---------------------------------")
+    for key in sorted(total):
+        print(f"  {avail.get(key, 0):.1f}/{total[key]:.1f} {key}")
+    rmt.shutdown()
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """Object summary of the runtime in THIS process (meaningful when
+    main() is invoked programmatically inside a driver; the runtime is
+    in-process, so a fresh CLI process has nothing to attach to)."""
+    from ray_memory_management_tpu import _worker_context, state
+
+    if _worker_context.get_runtime() is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['memory']))",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(state.summarize_objects(), indent=2))
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    import ray_memory_management_tpu as rmt
+    from ray_memory_management_tpu.utils.microbenchmark import (
+        run_microbenchmark,
+    )
+
+    _ephemeral_runtime()
+    results = run_microbenchmark(scale=args.scale)
+    for name, value in results.items():
+        unit = "GB/s" if "gigabytes" in name else "ops/s"
+        print(f"{name}: {value:,.1f} {unit}")
+    rmt.shutdown()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_memory_management_tpu as rmt
+
+    _ephemeral_runtime()
+    path = rmt.timeline(args.output)
+    print(f"trace written to {path}")
+    rmt.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------- jobs
+def cmd_job_submit(args) -> int:
+    from ray_memory_management_tpu.job_submission import JobSubmissionClient
+
+    import shlex
+
+    client = JobSubmissionClient(args.job_dir)
+    entrypoint = list(args.entrypoint)
+    if entrypoint and entrypoint[0] == "--":
+        entrypoint = entrypoint[1:]
+    if not entrypoint:
+        print("error: no entrypoint command given", file=sys.stderr)
+        return 2
+    # shlex.join preserves each argv element's quoting through the
+    # shell=True re-parse (plain ' '.join corrupts args with spaces)
+    job_id = client.submit_job(
+        entrypoint=shlex.join(entrypoint),
+        submission_id=args.submission_id)
+    print(job_id)
+    if args.wait:
+        for chunk in client.tail_job_logs(job_id, timeout_s=args.timeout):
+            sys.stdout.write(chunk)
+        status = client.get_job_status(job_id)
+        print(f"\njob {job_id} finished: {status}")
+        return 0 if status == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_job_list(args) -> int:
+    from ray_memory_management_tpu.job_submission import JobSubmissionClient
+
+    for meta in JobSubmissionClient(args.job_dir).list_jobs():
+        print(f"{meta['job_id']}  {meta['status']:10s}  "
+              f"{meta['entrypoint']}")
+    return 0
+
+
+def cmd_job_status(args) -> int:
+    from ray_memory_management_tpu.job_submission import JobSubmissionClient
+
+    print(JobSubmissionClient(args.job_dir).get_job_status(args.job_id))
+    return 0
+
+
+def cmd_job_logs(args) -> int:
+    from ray_memory_management_tpu.job_submission import JobSubmissionClient
+
+    sys.stdout.write(
+        JobSubmissionClient(args.job_dir).get_job_logs(args.job_id))
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    from ray_memory_management_tpu.job_submission import JobSubmissionClient
+
+    ok = JobSubmissionClient(args.job_dir).stop_job(args.job_id)
+    print("stopped" if ok else "not running")
+    return 0
+
+
+# --------------------------------------------------------------- workflow
+def cmd_workflow_list(args) -> int:
+    from ray_memory_management_tpu import workflow
+
+    for wid, status in workflow.list_all():
+        print(f"{wid}  {status}")
+    return 0
+
+
+def cmd_workflow_status(args) -> int:
+    from ray_memory_management_tpu import workflow
+
+    print(workflow.get_status(args.workflow_id))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rmt", description="TPU-native distributed runtime CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("status", help="show cluster resources")
+    s.add_argument("--num-nodes", type=int, default=1)
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("memory", help="object store summary")
+    s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser("microbenchmark",
+                       help="run the core microbenchmark suite")
+    s.add_argument("--scale", type=float, default=1.0)
+    s.set_defaults(fn=cmd_microbenchmark)
+
+    s = sub.add_parser("timeline", help="dump a chrome trace")
+    s.add_argument("--output", default="timeline.json")
+    s.set_defaults(fn=cmd_timeline)
+
+    job = sub.add_parser("job", help="job submission")
+    jsub = job.add_subparsers(dest="job_command", required=True)
+    s = jsub.add_parser("submit")
+    s.add_argument("--job-dir", default=None)
+    s.add_argument("--submission-id", default=None)
+    s.add_argument("--wait", action="store_true")
+    s.add_argument("--timeout", type=float, default=3600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_job_submit)
+    for name, fn, extra in (
+        ("list", cmd_job_list, ()),
+        ("status", cmd_job_status, ("job_id",)),
+        ("logs", cmd_job_logs, ("job_id",)),
+        ("stop", cmd_job_stop, ("job_id",)),
+    ):
+        s = jsub.add_parser(name)
+        s.add_argument("--job-dir", default=None)
+        for a in extra:
+            s.add_argument(a)
+        s.set_defaults(fn=fn)
+
+    wf = sub.add_parser("workflow", help="workflow management")
+    wsub = wf.add_subparsers(dest="workflow_command", required=True)
+    s = wsub.add_parser("list")
+    s.set_defaults(fn=cmd_workflow_list)
+    s = wsub.add_parser("status")
+    s.add_argument("workflow_id")
+    s.set_defaults(fn=cmd_workflow_status)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""State observability API.
+
+The reference's state API (python/ray/experimental/state/api.py —
+list_actors:719, list_tasks:942, list_objects:986, summaries :1233-1297)
+plus the GCS global-state reads in ray._private.state.
+"""
+
+from .api import (  # noqa: F401
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_objects,
+    summarize_tasks,
+)

@@ -1,0 +1,186 @@
+"""State API: structured views over live cluster state.
+
+The reference serves these from the dashboard's state head backed by GCS
+(experimental/state/api.py + state_aggregator); the single-process
+runtime answers them directly from the owner runtime + GCS tables. Every
+function returns plain list-of-dicts (the reference's .to_dict() rows)
+and supports the same filters=[(key, "=", value)] shape.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import _worker_context
+
+
+def _runtime():
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        raise RuntimeError("state API requires an initialized runtime "
+                           "(call init() first)")
+    return rt
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[Tuple[str, str, Any]]]):
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op in ("=", "=="):
+                ok = have == value
+            elif op == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def list_nodes(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    rows = []
+    with rt.gcs._lock:  # snapshot: registrations mutate this concurrently
+        infos = list(rt.gcs.nodes.values())
+    for info in infos:
+        rows.append({
+            "node_id": info.node_id.hex(),
+            "state": "ALIVE" if info.alive else "DEAD",
+            "resources_total": info.resources.total.to_dict(),
+            "labels": info.labels,
+            "store": info.store_name,
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    rows = []
+    with rt.gcs._lock:
+        records = list(rt.gcs.actors.values())
+    for rec in records:
+        rows.append({
+            "actor_id": rec.actor_id.hex(),
+            "class_name": getattr(rec.spec, "name", "Actor"),
+            "state": rec.state,
+            "node_id": rec.node_id.hex() if rec.node_id else None,
+            "name": getattr(rec.spec, "registered_name", None),
+            "num_restarts": rec.num_restarts,
+            "death_cause": rec.death_cause,
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    rows = []
+    with rt._lock:
+        records = list(rt.tasks.items())
+    for task_id, rec in records:
+        rows.append({
+            "task_id": task_id.hex(),
+            "name": rec.spec.name,
+            "state": rec.state,
+            "num_returns": rec.spec.num_returns,
+            "retries_left": rec.retries_left,
+            "is_actor_task": rec.spec.is_actor_task,
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    rows = []
+    with rt._lock:
+        mem = {oid: len(data) for oid, data in rt.memory_store.items()}
+    for oid, size in mem.items():
+        rows.append({
+            "object_id": oid.hex(),
+            "size_bytes": size,
+            "where": "memory_store",
+            "node_id": None,
+        })
+    with rt.gcs._lock:
+        locations = {oid: list(nodes) for oid, nodes
+                     in rt.gcs.object_locations.items()}
+    for oid, nodes in locations.items():
+        for node_id in nodes:
+            with rt._lock:
+                nm = rt.nodes.get(node_id)
+            size = None
+            where = "store"
+            if nm is not None and nm.alive:
+                try:
+                    # read shm directly: store.get() would RESTORE spilled
+                    # objects (disk read + shm fill) just to measure them
+                    view = nm.store.shm.get(oid)
+                    if view is not None:
+                        size = view.nbytes
+                        nm.store.shm.release(oid)
+                    elif nm.store.contains(oid):
+                        where = "spilled"
+                except Exception:
+                    size = None
+            rows.append({
+                "object_id": oid.hex(),
+                "size_bytes": size,
+                "where": where,
+                "node_id": node_id.hex(),
+            })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_workers(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    rows = []
+    with rt._lock:
+        node_managers = list(rt.nodes.values())
+    for nm in node_managers:
+        for handle in list(nm.workers.values()):
+            rows.append({
+                "worker_id": handle.worker_id.hex(),
+                "node_id": nm.node_id.hex(),
+                "pid": handle.proc.pid if handle.proc else None,
+                "alive": handle.alive(),
+                "is_actor_worker": handle.actor_id is not None,
+            })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None,
+                          limit: int = 10000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    if rt.pg_manager is None:
+        return []
+    from ..core.placement_group import placement_group_table
+
+    rows = list(placement_group_table().values())
+    return _apply_filters(rows, filters)[:limit]
+
+
+# ------------------------------------------------------------- summaries
+def summarize_tasks() -> Dict[str, Any]:
+    counts = Counter(r["state"] for r in list_tasks())
+    by_name = Counter(r["name"] for r in list_tasks())
+    return {"by_state": dict(counts),
+            "by_name": dict(by_name.most_common(20)),
+            "total": sum(counts.values())}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    counts = Counter(r["state"] for r in list_actors())
+    return {"by_state": dict(counts), "total": sum(counts.values())}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = list_objects()
+    total_bytes = sum(r["size_bytes"] or 0 for r in rows)
+    return {"count": len(rows), "total_bytes": total_bytes}

@@ -8,4 +8,9 @@ setup(
     # 3.12+ required: zero-copy store-buffer lifetime tracking uses PEP-688
     # (__buffer__ protocol) in serialization._StoreBufferView
     python_requires=">=3.12",
+    entry_points={
+        "console_scripts": [
+            "rmt=ray_memory_management_tpu.scripts.cli:main",
+        ],
+    },
 )
