@@ -43,8 +43,8 @@ def _inline_limit() -> int:
     if rt is not None:
         return rt.config.max_direct_call_object_size
     proxy = _worker_context.get_proxy()
-    if proxy is not None:
-        return proxy._worker.inline_limit
+    if proxy is not None:  # worker proxy or thin client, both expose it
+        return proxy.inline_limit
     return _INLINE_LIMIT_DEFAULT
 
 
@@ -108,6 +108,7 @@ class RemoteFunction:
             "strategy": _resolve_strategy(opts),
             "max_retries": opts.get("max_retries", 4),
             "retry_exceptions": bool(opts.get("retry_exceptions", False)),
+            "runtime_env": _validated_runtime_env(opts),
         }
         return_ids = _backend().submit_task(payload)
         refs = [ObjectRef(oid, _owner()) for oid in return_ids]
@@ -130,6 +131,15 @@ def _rebuild_remote_function(fn, options, fn_id):
     rf = RemoteFunction(fn, **options)
     rf._fn_id = fn_id
     return rf
+
+
+def _validated_runtime_env(opts) -> Optional[dict]:
+    env = opts.get("runtime_env")
+    if not env:
+        return None
+    from .runtime_env import validate
+
+    return validate(env)
 
 
 def _resolve_strategy(opts) -> Any:
@@ -241,6 +251,7 @@ class ActorClass:
             "detached": opts.get("lifetime") == "detached",
             "registered_name": opts.get("name"),
             "placement": opts.get("placement"),
+            "runtime_env": _validated_runtime_env(opts),
         }
         pg = opts.get("placement_group")
         if pg is not None:

@@ -1,0 +1,155 @@
+"""Cluster-side server for thin clients.
+
+The reference's client server + proxier (util/client/server/{server,
+proxier,dataservicer}.py) collapsed to one in-driver service: each client
+connection gets a handler thread; requests reuse the same operations the
+worker-request path serves, with object values inlined over the wire.
+"""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing.connection import Listener
+from typing import Any, Dict, Optional
+
+from .. import _worker_context
+from .. import serialization as ser
+
+
+class ClusterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 authkey: bytes = b"rmt-client"):
+        rt = _worker_context.get_runtime()
+        if rt is None:
+            raise RuntimeError("start the cluster first (init()), then "
+                               "serve it to clients")
+        self._rt = rt
+        self._authkey = authkey
+        self._listener = Listener((host, port), family="AF_INET",
+                                  authkey=authkey)
+        self.address = self._listener.address  # (host, bound_port)
+        self._stop = threading.Event()
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rmt-client-accept")
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._stop.is_set():
+                    return
+                continue
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="rmt-client-conn").start()
+
+    def _serve_conn(self, conn) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                threading.Thread(
+                    target=self._handle, args=(conn, send_lock, msg),
+                    daemon=True).start()
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn, send_lock, msg: Dict[str, Any]) -> None:
+        reply: Dict[str, Any] = {"req_id": msg.get("req_id"), "error": None}
+        rt = self._rt
+        try:
+            mtype = msg["type"]
+            if mtype == "submit_task":
+                reply["return_ids"] = rt.submit_task(msg["payload"])
+            elif mtype == "submit_actor_task":
+                reply["return_ids"] = rt.submit_actor_task(msg["payload"])
+            elif mtype == "create_actor":
+                reply["actor_id"] = rt.create_actor(msg["payload"])
+            elif mtype == "get_objects":
+                values = rt.get_objects(msg["oids"], msg.get("timeout"))
+                reply["values"] = [ser.dumps(v) for v in values]
+            elif mtype == "put":
+                reply["object_id"] = rt.put_object(ser.loads(msg["data"]))
+            elif mtype == "wait":
+                ready, not_ready = rt.wait(
+                    msg["oids"], msg["num_returns"], msg["timeout"])
+                reply["ready"], reply["not_ready"] = ready, not_ready
+            elif mtype == "kill_actor":
+                rt.kill_actor(msg["actor_id"], msg["no_restart"])
+            elif mtype == "cancel_task":
+                rt.cancel(msg["object_id"], msg["force"])
+            elif mtype == "get_named_actor":
+                rec = rt.gcs.get_named_actor(msg["name"])
+                if rec is None:
+                    raise ValueError(f"no actor named {msg['name']!r}")
+                reply["actor_id"] = rec.actor_id.binary()
+            elif mtype == "cluster_resources":
+                reply["resources"] = rt.scheduler.cluster_resources()
+            elif mtype == "create_pg":
+                from ..core.placement_group import _manager
+
+                pg = _manager(rt).create(
+                    msg["bundles"], msg["strategy"], msg.get("name", ""))
+                reply["pg_id"] = pg.id
+            elif mtype == "pg_state":
+                from ..core.placement_group import _manager
+
+                reply["state"] = _manager(rt).state(msg["pg_id"])
+            elif mtype == "wait_pg":
+                from ..core.placement_group import _manager
+
+                reply["created"] = _manager(rt).wait_created(
+                    msg["pg_id"], msg["timeout"])
+            elif mtype == "remove_pg":
+                from ..core.placement_group import _manager
+
+                _manager(rt).remove(msg["pg_id"])
+            elif mtype == "ping":
+                reply["pong"] = True
+            else:
+                raise ValueError(f"unknown client request {mtype!r}")
+        except Exception as e:  # noqa: BLE001 — surfaces client-side
+            try:
+                reply = {"req_id": msg.get("req_id"), "error": ser.dumps(e)}
+            except Exception:
+                reply = {"req_id": msg.get("req_id"),
+                         "error": ser.dumps(RuntimeError(str(e)))}
+        try:
+            with send_lock:
+                conn.send(reply)
+        except (OSError, BrokenPipeError):
+            pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        # close live client connections so their pending requests fail
+        # fast instead of hanging out the full request timeout
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -343,6 +343,7 @@ class Runtime:
                 "max_retries", self.config.task_max_retries
             ),
             retry_exceptions=payload.get("retry_exceptions", False),
+            runtime_env=payload.get("runtime_env"),
         )
         rec = _TaskRecord(spec, payload, spec.max_retries)
         with self._lock:
@@ -550,6 +551,8 @@ class Runtime:
                 "name": spec.name, "args": args, "kwargs": kwargs,
                 "return_ids": spec.return_ids,
             }
+            if spec.runtime_env:
+                msg["runtime_env"] = spec.runtime_env
             if spec.fn_id not in handle.known_fns:
                 msg["fn_blob"] = self.fn_blobs[spec.fn_id]
                 handle.known_fns.add(spec.fn_id)
@@ -628,6 +631,7 @@ class Runtime:
             placement=payload.get("placement"),
             detached=payload.get("detached", False),
             registered_name=payload.get("registered_name"),
+            runtime_env=payload.get("runtime_env"),
         )
         record = ActorRecord(actor_id, spec)
         self.gcs.register_actor(record)
@@ -682,6 +686,8 @@ class Runtime:
                        for k, v in spec.kwargs.items()},
             "max_concurrency": spec.max_concurrency,
         }
+        if spec.runtime_env:
+            msg["runtime_env"] = spec.runtime_env
         if spec.cls_id not in handle.known_classes:
             msg["cls_blob"] = self.cls_blobs[spec.cls_id]
             handle.known_classes.add(spec.cls_id)

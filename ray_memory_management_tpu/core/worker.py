@@ -61,6 +61,10 @@ class WorkerRuntimeProxy:
         self._req_counter = 0
         self._lock = threading.Lock()
 
+    @property
+    def inline_limit(self) -> int:
+        return self._worker.inline_limit
+
     def _request(self, msg: dict, timeout: Optional[float] = None):
         with self._lock:
             self._req_counter += 1
@@ -317,7 +321,10 @@ class Worker:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
-            result = fn(*args, **kwargs)
+            from ..runtime_env import applied as _env_applied
+
+            with _env_applied(msg.get("runtime_env")):
+                result = fn(*args, **kwargs)
             returns = self._split_returns(result, msg["return_ids"])
             reply = {
                 "type": "done", "task_id": task_id,
@@ -383,12 +390,17 @@ class Worker:
                 cls = cloudpickle.loads(msg["cls_blob"])
                 self.classes[cls_id] = cls
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
+            # actors own their dedicated worker process: the env applies
+            # for the process lifetime (async + concurrent methods see it
+            # with no per-call save/restore races)
+            from ..runtime_env import apply_permanent
+
+            apply_permanent(msg.get("runtime_env"))
             instance = cls(*args, **kwargs)
             for oid in pinned:
                 self.store.release(oid)
-            self.actors[actor_id] = _ActorState(
-                instance, msg.get("max_concurrency", 1)
-            )
+            state = _ActorState(instance, msg.get("max_concurrency", 1))
+            self.actors[actor_id] = state
             reply = {"type": "actor_created", "actor_id": actor_id,
                      "error": None}
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,140 @@
+"""Dashboard: HTTP observability endpoint over the live cluster.
+
+The reference's dashboard (dashboard/head.py:62 aiohttp head + per-node
+agents + React UI) reduced to its data surface: a stdlib HTTP server in
+the driver process exposing the state API as JSON, cluster resources,
+jobs, and Prometheus metrics, plus a minimal HTML overview. Runs
+in-process because cluster state lives in the driver runtime.
+
+Routes::
+
+    /                       HTML overview
+    /api/cluster            resources total/available
+    /api/nodes|actors|tasks|objects|workers|placement_groups
+    /api/jobs               job-submission table
+    /metrics                Prometheus exposition text
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+_HTML = """<!doctype html>
+<title>rmt dashboard</title>
+<style>body{font-family:monospace;margin:2em}td,th{padding:2px 10px;
+text-align:left}h2{margin-top:1.2em}</style>
+<h1>rmt cluster</h1>
+<div id=out>loading…</div>
+<script>
+const SECTIONS = ["cluster","nodes","actors","tasks","workers"];
+async function refresh() {
+  const out = document.createElement("div");
+  for (const s of SECTIONS) {
+    const data = await (await fetch("/api/" + s)).json();
+    const h2 = document.createElement("h2");
+    h2.textContent = s;                       // textContent: cluster data
+    const pre = document.createElement("pre"); // is untrusted for HTML
+    pre.textContent = JSON.stringify(data, null, 2);
+    out.append(h2, pre);
+  }
+  document.getElementById("out").replaceChildren(...out.children);
+}
+refresh(); setInterval(refresh, 5000);
+</script>
+"""
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    status, ctype, body = dash._route(self.path)
+                except Exception as e:  # noqa: BLE001
+                    status, ctype = 500, "application/json"
+                    body = json.dumps({"error": str(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="rmt-dashboard")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _route(self, path: str):
+        from . import state
+
+        path = path.split("?")[0].rstrip("/") or "/"
+        if path == "/":
+            return 200, "text/html", _HTML.encode()
+        if path == "/metrics":
+            from .utils.metrics import export_prometheus
+
+            return 200, "text/plain; version=0.0.4", \
+                export_prometheus().encode()
+        if path == "/api/cluster":
+            from . import api
+
+            data = {
+                "resources_total": api.cluster_resources(),
+                "resources_available": api.available_resources(),
+                "nodes": len(api.nodes()),
+            }
+        elif path == "/api/nodes":
+            data = state.list_nodes()
+        elif path == "/api/actors":
+            data = state.list_actors()
+        elif path == "/api/tasks":
+            data = state.list_tasks()
+        elif path == "/api/objects":
+            data = state.list_objects()
+        elif path == "/api/workers":
+            data = state.list_workers()
+        elif path == "/api/placement_groups":
+            data = state.list_placement_groups()
+        elif path == "/api/jobs":
+            from .job_submission import JobSubmissionClient
+
+            data = JobSubmissionClient().list_jobs()
+        else:
+            return 404, "application/json", b'{"error": "not found"}'
+        return 200, "application/json", json.dumps(data).encode()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

@@ -1,0 +1,161 @@
+"""Runtime environments: per-task/actor execution context.
+
+The reference installs conda/pip/container/working_dir/py_modules
+environments through the per-node agent and starts dedicated workers
+inside them (python/ray/_private/runtime_env/{conda,pip,working_dir,
+py_modules}.py, plugin.py; worker_pool.h:446 dedicated workers). The
+host-process TPU model keeps one pooled worker per slot, so supported
+fields apply at execution time and roll back afterwards:
+
+  - ``env_vars``: exported around the call
+  - ``working_dir``: a directory copied once into a per-env cache
+    (URI-cache analog, uri_cache.py) and chdir'd into
+  - ``py_modules``: local dirs/files prepended to sys.path
+
+``conda``/``pip``/``container`` would need process-level isolation; they
+raise a clear error rather than silently half-working (this image also
+forbids installs). The plugin hook mirrors plugin.py: a callable
+``setup(env_dict) -> context_manager`` registered by name.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+_UNSUPPORTED = ("conda", "pip", "container")
+_plugins: Dict[str, Callable[[Any], Any]] = {}
+
+
+def register_plugin(name: str, setup: Callable[[Any], Any]) -> None:
+    """Register ``setup(value) -> context manager`` for a custom key."""
+    _plugins[name] = setup
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if not runtime_env:
+        return {}
+    for key in runtime_env:
+        if key in _UNSUPPORTED:
+            raise ValueError(
+                f"runtime_env[{key!r}] needs process-level isolation that "
+                "the pooled host-process worker model does not provide "
+                "(and this environment forbids package installs)")
+        if key not in ("env_vars", "working_dir", "py_modules") and \
+                key not in _plugins:
+            raise ValueError(f"unknown runtime_env key {key!r}")
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None and not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()):
+        raise ValueError("env_vars must be Dict[str, str]")
+    return dict(runtime_env)
+
+
+_WD_CACHE = os.path.join(tempfile.gettempdir(), "rmt_runtime_env_wd")
+
+
+def _dir_digest(src: str) -> str:
+    """Content key: relative names + sizes + mtimes of every file, so an
+    edited working_dir gets a fresh cache entry (uri_cache.py keys by
+    content URI the same way)."""
+    h = hashlib.sha256(os.path.abspath(src).encode())
+    for root, dirs, files in sorted(os.walk(src)):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            rel = os.path.relpath(full, src)
+            h.update(f"{rel}:{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()[:16]
+
+
+def _materialize_working_dir(src: str) -> str:
+    """Copy the working dir into a content-keyed cache once per host."""
+    dest = os.path.join(_WD_CACHE, _dir_digest(src))
+    if not os.path.isdir(dest):
+        os.makedirs(_WD_CACHE, exist_ok=True)
+        # private tmp dir per copier: concurrent materializers each copy
+        # into their own staging area; rename is atomic, losers clean up
+        tmp = tempfile.mkdtemp(dir=_WD_CACHE, prefix=".staging-")
+        staged = os.path.join(tmp, "wd")
+        shutil.copytree(src, staged)
+        try:
+            os.rename(staged, dest)
+        except OSError:
+            pass  # another process won the race
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
+    """Apply an env for the remainder of this process — used for actors,
+    whose worker process is dedicated to them (no rollback needed, and
+    async methods see the env without any per-call bookkeeping)."""
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    wd = runtime_env.get("working_dir")
+    if wd:
+        target = _materialize_working_dir(wd)
+        os.chdir(target)
+        sys.path.insert(0, target)
+    for mod in runtime_env.get("py_modules") or []:
+        sys.path.insert(0, os.path.abspath(mod))
+    for key, value in runtime_env.items():
+        if key in _plugins:
+            cm = _plugins[key](value)
+            cm.__enter__()  # intentionally never exited
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[Dict[str, Any]]):
+    """Apply a runtime env around one task execution; restore after.
+    Used for PLAIN tasks only, which execute serially on the worker's
+    single-thread task executor — the save/restore is race-free because
+    no other task can interleave. Actors use apply_permanent()."""
+    if not runtime_env:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd: Optional[str] = None
+    saved_path_len = len(sys.path)
+    stack = contextlib.ExitStack()
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = runtime_env.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            target = _materialize_working_dir(wd)
+            os.chdir(target)
+            sys.path.insert(0, target)
+        for mod in runtime_env.get("py_modules") or []:
+            sys.path.insert(0, os.path.abspath(mod))
+        for key, value in runtime_env.items():
+            if key in _plugins:
+                stack.enter_context(_plugins[key](value))
+        yield
+    finally:
+        stack.close()
+        del sys.path[: max(0, len(sys.path) - saved_path_len)]
+        if saved_cwd is not None:
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old

@@ -1,0 +1,175 @@
+"""Thin-client mode, runtime envs, dashboard (reference coverage shape:
+test_client.py, test_runtime_env*.py, dashboard tests)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu.client import ClusterServer
+
+
+class TestRuntimeEnv:
+    def test_env_vars(self, rmt_start_regular):
+        @rmt.remote(runtime_env={"env_vars": {"RMT_TEST_VAR": "tpu!"}})
+        def read_env():
+            return os.environ.get("RMT_TEST_VAR")
+
+        assert rmt.get(read_env.remote()) == "tpu!"
+
+        @rmt.remote
+        def read_plain():
+            return os.environ.get("RMT_TEST_VAR")
+
+        assert rmt.get(read_plain.remote()) is None  # restored after
+
+    def test_working_dir(self, rmt_start_regular, tmp_path):
+        src = tmp_path / "proj"
+        src.mkdir()
+        (src / "data.txt").write_text("payload")
+
+        @rmt.remote(runtime_env={"working_dir": str(src)})
+        def read_file():
+            return open("data.txt").read()
+
+        assert rmt.get(read_file.remote()) == "payload"
+
+    def test_py_modules(self, rmt_start_regular, tmp_path):
+        mod = tmp_path / "extra_mod.py"
+        mod.write_text("MAGIC = 77\n")
+
+        @rmt.remote(runtime_env={"py_modules": [str(tmp_path)]})
+        def use_module():
+            import extra_mod
+
+            return extra_mod.MAGIC
+
+        assert rmt.get(use_module.remote()) == 77
+
+    def test_actor_runtime_env(self, rmt_start_regular):
+        @rmt.remote(runtime_env={"env_vars": {"ACTOR_ENV": "on"}})
+        class EnvActor:
+            def __init__(self):
+                self.at_init = os.environ.get("ACTOR_ENV")
+
+            def probe(self):
+                return self.at_init, os.environ.get("ACTOR_ENV")
+
+        a = EnvActor.remote()
+        assert rmt.get(a.probe.remote()) == ("on", "on")
+        rmt.kill(a)
+
+    def test_unsupported_keys_rejected(self, rmt_start_regular):
+        @rmt.remote(runtime_env={"pip": ["requests"]})
+        def nope():
+            return 1
+
+        with pytest.raises(ValueError):
+            nope.remote()
+
+
+class TestClientMode:
+    def test_client_roundtrip_subprocess(self, rmt_start_regular):
+        """A separate process connects as a thin client and drives the
+        cluster (the reference's ray://-init e2e shape)."""
+        server = ClusterServer(port=0)
+        script = f"""
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu.client import connect, disconnect
+connect("127.0.0.1:{server.port}")
+
+@rmt.remote
+def double(x):
+    return 2 * x
+
+refs = [double.remote(i) for i in range(5)]
+assert rmt.get(refs) == [0, 2, 4, 6, 8]
+
+@rmt.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def add(self, k):
+        self.n += k
+        return self.n
+
+c = Counter.remote()
+assert rmt.get(c.add.remote(3)) == 3
+assert rmt.get(c.add.remote(4)) == 7
+
+ref = rmt.put({{"big": list(range(1000))}})
+assert rmt.get(ref)["big"][-1] == 999
+ready, pending = rmt.wait([double.remote(1)], num_returns=1, timeout=30)
+assert len(ready) == 1
+rmt.kill(c)
+disconnect()
+print("CLIENT OK")
+"""
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=240)
+        assert "CLIENT OK" in out.stdout, out.stderr
+        server.close()
+
+    def test_named_actor_via_client(self, rmt_start_regular):
+        @rmt.remote
+        class Registry:
+            def ping(self):
+                return "reg"
+
+        Registry.options(name="shared_reg", lifetime="detached").remote()
+        server = ClusterServer(port=0)
+        script = f"""
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu.client import connect
+connect("127.0.0.1:{server.port}")
+h = rmt.get_actor("shared_reg")
+assert rmt.get(h.ping.remote()) == "reg"
+print("NAMED OK")
+"""
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=240)
+        assert "NAMED OK" in out.stdout, out.stderr
+        server.close()
+
+
+class TestDashboard:
+    def test_routes(self, rmt_start_regular):
+        from ray_memory_management_tpu.dashboard import (
+            start_dashboard, stop_dashboard,
+        )
+
+        @rmt.remote
+        def touch():
+            return 1
+
+        rmt.get(touch.remote())
+        dash = start_dashboard(port=0)
+        try:
+            def fetch(path):
+                try:
+                    with urllib.request.urlopen(dash.url + path,
+                                                timeout=30) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            status, body = fetch("/api/cluster")
+            assert status == 200
+            assert json.loads(body)["nodes"] == 1
+            status, body = fetch("/api/tasks")
+            assert any(t["name"] == "touch" for t in json.loads(body))
+            status, body = fetch("/api/nodes")
+            assert json.loads(body)[0]["state"] == "ALIVE"
+            status, body = fetch("/")
+            assert b"rmt cluster" in body
+            status, body = fetch("/metrics")
+            assert status == 200
+            status, _ = fetch("/api/bogus")
+            assert status == 404
+        finally:
+            stop_dashboard()
