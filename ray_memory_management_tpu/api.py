@@ -303,10 +303,18 @@ def method(num_returns: int = 1):
 
 
 # ------------------------------------------------------------------ objects
-def put(value: Any) -> ObjectRef:
+def put(value: Any, *, device: bool = False) -> ObjectRef:
+    """Store a value and return its ref. ``device=True`` pins a
+    jax.Array in the calling process's device store — the ref points at
+    live HBM, same-process gets are zero-copy, and remote readers pull a
+    host copy materialized on demand (SURVEY.md §7; net-new vs the
+    reference's host-only plasma)."""
     if isinstance(value, ObjectRef):
         raise TypeError("put() of an ObjectRef is not allowed")
-    oid = _backend().put_object(value)
+    if device:
+        oid = _backend().put_device_object(value)
+    else:
+        oid = _backend().put_object(value)
     return ObjectRef(oid, _owner())
 
 

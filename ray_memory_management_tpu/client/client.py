@@ -108,6 +108,12 @@ class ClientBackend:
         return self._request(
             {"type": "put", "data": data.to_bytes()})["object_id"]
 
+    def put_device_object(self, value: Any) -> bytes:
+        # a thin client has no cluster-side device; the server pins the
+        # rebuilt array in the driver's device store
+        return self._request(
+            {"type": "put_device", "data": ser.dumps(value)})["object_id"]
+
     def wait(self, oids, num_returns, timeout,
              fetch_local=True) -> Tuple[List[bytes], List[bytes]]:
         reply = self._request(

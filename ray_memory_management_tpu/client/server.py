@@ -87,6 +87,9 @@ class ClusterServer:
                 reply["values"] = [ser.dumps(v) for v in values]
             elif mtype == "put":
                 reply["object_id"] = rt.put_object(ser.loads(msg["data"]))
+            elif mtype == "put_device":
+                reply["object_id"] = rt.put_device_object(
+                    ser.loads(msg["data"]))
             elif mtype == "wait":
                 ready, not_ready = rt.wait(
                     msg["oids"], msg["num_returns"], msg["timeout"])

@@ -74,6 +74,13 @@ _flag("tpu_visible_chips_env", str, "TPU_VISIBLE_CHIPS",
       "CUDA_VISIBLE_DEVICES handling (_raylet.pyx:563, _private/utils.py:349).")
 
 # --- misc --------------------------------------------------------------------
+_flag("memory_monitor_interval_s", float, 0.0,
+      "Node OOM-monitor check period (memory_monitor.h analog). 0 "
+      "disables it; when enabled, host memory above the threshold kills "
+      "the newest running task's worker (it retries under its budget).")
+_flag("memory_usage_threshold", float, 0.95,
+      "Fraction of host memory use that triggers the OOM kill "
+      "(ray_config_def.h memory_usage_threshold analog).")
 _flag("event_stats", bool, True,
       "Collect per-handler event-loop stats (src/ray/common/event_stats.cc).")
 _flag("log_to_driver", bool, True, "Forward worker logs to the driver.")

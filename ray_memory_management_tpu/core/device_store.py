@@ -1,0 +1,78 @@
+"""Device object store: ObjectRefs pinning accelerator-resident arrays.
+
+The north-star capability (BASELINE.json: "ObjectRefs pinned in TPU
+HBM"): the reference's plasma store is host-shm only (SURVEY.md — no GPU
+object store in the snapshot), so this is net-new, designed per
+SURVEY.md §7:
+
+  - XLA owns HBM: a device object IS a live ``jax.Array`` pinned by the
+    process that produced it (the per-host arena of XLA buffers). There
+    is no HBM mmap analog, so device objects are process-local by
+    construction; the host-process-per-TPU-host model makes that the
+    natural ownership unit.
+  - Same-process consumers get the buffer back zero-copy (actor-to-actor
+    handoff without leaving HBM).
+  - Cross-process consumers trigger on-demand materialization: the
+    owning process copies device→host and writes the serialized value
+    into its node's shm store (the spill tier), after which the normal
+    object plane (shm / DCN push-pull) takes over. The device copy stays
+    pinned for local readers until the ref count drops.
+  - A dead owner process loses its device objects; recovery is lineage
+    re-execution, same as any lost object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+def is_device_array(value: Any) -> bool:
+    """True for a jax.Array (CPU-backed arrays also benefit from
+    zero-copy process-local pinning). One shared detector with the
+    serializer so the put and serialize paths always agree."""
+    from ..serialization import _is_jax_array
+
+    return _is_jax_array(value)
+
+
+class DeviceObjectStore:
+    """Process-local pin table: object id -> live jax.Array."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[bytes, Any] = {}
+
+    def put(self, object_id: bytes, array: Any) -> None:
+        with self._lock:
+            self._objects[object_id] = array
+
+    def get(self, object_id: bytes) -> Optional[Any]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def delete(self, object_id: bytes) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def ids(self) -> List[bytes]:
+        with self._lock:
+            return list(self._objects)
+
+    def nbytes(self, object_id: bytes) -> Optional[int]:
+        with self._lock:
+            arr = self._objects.get(object_id)
+        if arr is None:
+            return None
+        try:
+            return int(arr.nbytes)
+        except Exception:
+            return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._objects.clear()

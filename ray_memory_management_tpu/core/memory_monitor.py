@@ -1,0 +1,112 @@
+"""Node memory monitor: kill workloads before the OS OOM-killer does.
+
+The reference's ``MemoryMonitor`` (src/ray/common/memory_monitor.h:48,
+kill callback wired in node_manager.cc:336-339,2409): sample host memory
+usage on an interval; past the threshold, invoke a kill callback that
+terminates the most-recently-started task's worker (newest-first
+preserves the oldest — most-progressed — work, the reference's retry-
+friendly policy; the killed task retries under its normal budget).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+
+def system_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) from /proc/meminfo — available-based,
+    like memory_monitor.h's cgroup/proc reads."""
+    total = available = None
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                available = int(line.split()[1]) * 1024
+            if total is not None and available is not None:
+                break
+    if total is None or available is None:
+        raise RuntimeError("could not read /proc/meminfo")
+    return total - available, total
+
+
+class MemoryMonitor:
+    def __init__(self,
+                 kill_callback: Callable[[], bool],
+                 usage_threshold: float = 0.95,
+                 check_interval_s: float = 1.0,
+                 usage_fn: Callable[[], Tuple[int, int]] = None):
+        """``kill_callback`` should relieve pressure (kill one worker)
+        and return True if it killed something; ``usage_fn`` is
+        injectable for tests."""
+        self.kill_callback = kill_callback
+        self.usage_threshold = usage_threshold
+        self.check_interval_s = check_interval_s
+        self.usage_fn = usage_fn or system_memory_usage
+        self.num_kills = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # allow stop() → start() restart
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rmt-memory-monitor")
+        self._thread.start()
+
+    def is_over_threshold(self) -> bool:
+        used, total = self.usage_fn()
+        return total > 0 and used / total >= self.usage_threshold
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
+        while not self._stop.is_set():
+            try:
+                if self.is_over_threshold():
+                    if self.kill_callback():
+                        self.num_kills += 1
+                        log.warning(
+                            "memory pressure: killed a worker to free "
+                            "memory (%d kills total)", self.num_kills)
+            except Exception:
+                log.exception("memory monitor check failed")
+            self._stop.wait(self.check_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def make_newest_task_killer(runtime) -> Callable[[], bool]:
+    """The reference's policy: prefer killing the task that started most
+    recently (node_manager.cc retriable-task-first). Returns a callback
+    that terminates one busy non-actor worker's process; the owner's
+    retry logic resubmits the task."""
+
+    def kill_one() -> bool:
+        with runtime._lock:
+            node_managers = list(runtime.nodes.values())
+        candidates = []  # (start order proxy, handle)
+        for nm in node_managers:
+            if not nm.alive:
+                continue
+            for handle in list(nm.workers.values()):
+                if handle.actor_id is not None or not handle.inflight:
+                    continue
+                candidates.append(handle)
+        if not candidates:
+            return False
+        victim = candidates[-1]  # newest-started worker
+        try:
+            victim.proc.terminate()
+            return True
+        except Exception:
+            return False
+
+    return kill_one

@@ -90,6 +90,12 @@ class Runtime:
 
         # owner state
         self.memory_store: Dict[bytes, bytes] = {}  # small objects (serialized)
+        from .device_store import DeviceObjectStore
+
+        self.device_store = DeviceObjectStore()  # driver-pinned jax.Arrays
+        # device-object ownership: oid -> "driver" | WorkerHandle
+        self._device_locations: Dict[bytes, Any] = {}
+        self._materialize_futs: Dict[bytes, Future] = {}
         self.futures: Dict[bytes, Future] = {}
         self.tasks: Dict[bytes, _TaskRecord] = {}
         self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
@@ -140,6 +146,16 @@ class Runtime:
             target=self._heartbeat_loop, daemon=True, name="rmt-heartbeat"
         )
         self._hb.start()
+        self._memory_monitor = None
+        if config.memory_monitor_interval_s > 0:
+            from .memory_monitor import MemoryMonitor, make_newest_task_killer
+
+            self._memory_monitor = MemoryMonitor(
+                make_newest_task_killer(self),
+                usage_threshold=config.memory_usage_threshold,
+                check_interval_s=config.memory_monitor_interval_s,
+            )
+            self._memory_monitor.start()
         for nm in self.nodes.values():
             nm.prestart()
         # best-effort cleanup if the driver exits without shutdown(): shm
@@ -313,6 +329,8 @@ class Runtime:
             self._on_task_done(handle, msg)
         elif mtype == "actor_created":
             self._on_actor_created(handle, msg)
+        elif mtype == "device_materialized":
+            self._on_device_materialized(handle, msg)
         elif mtype == "pong":
             pass
         else:
@@ -466,6 +484,13 @@ class Runtime:
                 locs = [l for l in locs if l != node_id and
                         self.nodes.get(l) and self.nodes[l].alive]
                 if not locs:
+                    if oid in self._device_locations:
+                        # device-resident dep: materialize off the router
+                        # thread, then re-place the task
+                        self._transfer_pool.submit(
+                            self._materialize_then_reschedule, oid, spec,
+                            node_id)
+                        return False
                     # lost object: trigger recovery, then retry scheduling
                     self._transfer_pool.submit(
                         self._recover_then_reschedule, oid, spec, node_id
@@ -511,6 +536,15 @@ class Runtime:
                                  node_id: NodeID) -> None:
         try:
             self._recover_object(oid)
+            self._place_on_node(spec, node_id)
+        except Exception as e:
+            self._fail_task(spec, TaskError(spec.name, e))
+
+    def _materialize_then_reschedule(self, oid: bytes, spec: TaskSpec,
+                                     node_id: NodeID) -> None:
+        try:
+            if not self._ensure_device_materialized(oid):
+                self._recover_object(oid)
             self._place_on_node(spec, node_id)
         except Exception as e:
             self._fail_task(spec, TaskError(spec.name, e))
@@ -793,6 +827,16 @@ class Runtime:
             with self._lock:
                 info.pending.append(spec)
             return
+        # device-resident deps block on a worker round-trip the router
+        # itself must service — never materialize on the router thread
+        with self._lock:
+            has_device_dep = any(o in self._device_locations
+                                 for o in self._ref_deps(spec))
+        if has_device_dep and \
+                threading.current_thread() is self._router:
+            self._request_pool.submit(
+                self._ensure_actor_args_then_send, info, spec)
+            return
         node_id = info.node_id
         # transfer any store-resident args to the actor's node
         for oid in self._ref_deps(spec):
@@ -802,12 +846,13 @@ class Runtime:
                 continue
             if self.nodes[node_id].store.contains(oid):
                 continue
+            self._ensure_device_materialized(oid)
             locs = [l for l in self.gcs.get_object_locations(oid)
                     if l != node_id and self.nodes.get(l)
                     and self.nodes[l].alive]
             if locs:
                 self._transfer_object(oid, locs[0], node_id)
-            else:
+            elif not self.nodes[node_id].store.contains(oid):
                 try:
                     self._recover_object(oid)
                 except Exception as e:
@@ -859,6 +904,7 @@ class Runtime:
         nm = self.nodes.get(handle.node_id)
         if nm:
             nm.remove_worker(handle)
+        self._drop_device_location(handle)
         if handle.actor_id is not None:
             self._on_actor_worker_death(handle, inflight)
         else:
@@ -940,6 +986,119 @@ class Runtime:
                 self.remove_node(node_id)
             self._stop.wait(interval)
 
+    # --------------------------------------------------------- device objects
+    def put_device_object(self, value: Any) -> bytes:
+        """Pin a jax.Array in THIS process's device store (HBM-resident
+        ObjectRef — SURVEY.md §7 design; see device_store.py)."""
+        from .device_store import is_device_array
+
+        if not is_device_array(value):
+            raise TypeError(
+                "put(..., device=True) requires a jax.Array; got "
+                f"{type(value).__name__}")
+        oid = ObjectID.for_put().binary()
+        self.device_store.put(oid, value)
+        with self._lock:
+            self._device_locations[oid] = "driver"
+            fut = Future()
+            fut.set_result(True)
+            self.futures[oid] = fut
+        return oid
+
+    def reserve_device_put(self, handle: WorkerHandle) -> bytes:
+        """Worker-side device put, step 1: allocate the id and register
+        the owning worker; the seal message completes it."""
+        oid = ObjectID.for_put().binary()
+        with self._lock:
+            self._device_locations[oid] = handle
+            self.futures[oid] = Future()  # resolved by device_put_sealed
+        return oid
+
+    def seal_device_put(self, oid: bytes) -> None:
+        with self._lock:
+            fut = self.futures.get(oid)
+        if fut is not None and not fut.done():
+            fut.set_result(True)
+        self._on_dep_ready(oid)
+
+    def _ensure_device_materialized(self, oid: bytes,
+                                    timeout: float = 120.0) -> bool:
+        """Make a device-resident object readable through the normal host
+        object plane: the owner copies device→host into its node store on
+        demand (the spill tier). Returns False if oid is not a device
+        object or its owner is gone."""
+        with self._lock:
+            loc = self._device_locations.get(oid)
+        if loc is None:
+            return False
+        # wait for the seal (producer may still be storing)
+        with self._lock:
+            seal = self.futures.get(oid)
+        if seal is not None:
+            seal.result(timeout=timeout)
+        if loc == "driver":
+            arr = self.device_store.get(oid)
+            if arr is None:
+                return False
+            nm = self.head_node()
+            if not nm.store.contains(oid):
+                try:
+                    nm.store.put_serialized(oid, ser.serialize(arr))
+                except ValueError:
+                    pass  # concurrent reader materialized it first
+                self.gcs.add_object_location(oid, nm.node_id)
+            return True
+        # worker-owned: one materialize request, shared by all waiters
+        if not loc.alive():
+            return False
+        if self.gcs.get_object_locations(oid):
+            return True  # already materialized earlier
+        with self._lock:
+            fut = self._materialize_futs.get(oid)
+            if fut is None:
+                fut = Future()
+                self._materialize_futs[oid] = fut
+                send_needed = True
+            else:
+                send_needed = False
+        if send_needed:
+            if not self._send(loc, {"type": "materialize_device",
+                                    "object_id": oid}):
+                with self._lock:
+                    self._materialize_futs.pop(oid, None)
+                return False
+        try:
+            fut.result(timeout=timeout)
+        except Exception:
+            return False
+        return True
+
+    def _on_device_materialized(self, handle: WorkerHandle,
+                                msg: dict) -> None:
+        oid = msg["object_id"]
+        if msg.get("error") is None:
+            self.gcs.add_object_location(oid, handle.node_id)
+        with self._lock:
+            fut = self._materialize_futs.pop(oid, None)
+        if fut is not None and not fut.done():
+            if msg.get("error") is not None:
+                fut.set_exception(ser.loads(msg["error"]))
+            else:
+                fut.set_result(True)
+
+    def _drop_device_location(self, handle: WorkerHandle) -> None:
+        """Owner process died: its device objects are gone; gets fall
+        through to lineage recovery."""
+        with self._lock:
+            dead = [oid for oid, loc in self._device_locations.items()
+                    if loc is handle]
+            for oid in dead:
+                del self._device_locations[oid]
+                fut = self._materialize_futs.pop(oid, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(ObjectLostError(
+                        oid.hex(), "device-object owner process died"))
+
     # ------------------------------------------------------------ object api
     def put_object(self, value: Any) -> bytes:
         data = ser.serialize(value)
@@ -988,6 +1147,10 @@ class Runtime:
         return results
 
     def _get_one(self, oid: bytes, deadline: Optional[float]):
+        # driver-pinned device object: zero-copy return of the live array
+        arr = self.device_store.get(oid)
+        if arr is not None:
+            return arr
         for attempt in range(3):
             with self._lock:
                 fut = self.futures.get(oid)
@@ -1009,6 +1172,11 @@ class Runtime:
             value, found = self._read_from_stores(oid)
             if found:
                 return value
+            # device-resident elsewhere: materialize device→host, re-read
+            if self._ensure_device_materialized(oid):
+                value, found = self._read_from_stores(oid)
+                if found:
+                    return value
             # Not in memory, not in any store: lost. Try lineage recovery
             # (ObjectRecoveryManager, object_recovery_manager.h:41).
             try:
@@ -1108,6 +1276,11 @@ class Runtime:
         """Drop an object's value everywhere (ray.internal.free analog)."""
         with self._lock:
             self.memory_store.pop(oid, None)
+            loc = self._device_locations.pop(oid, None)
+        if loc == "driver":
+            self.device_store.delete(oid)
+        elif loc is not None:
+            self._send(loc, {"type": "free_device", "object_id": oid})
         for node_id in self.gcs.get_object_locations(oid):
             nm = self.nodes.get(node_id)
             if nm and nm.alive:
@@ -1139,6 +1312,10 @@ class Runtime:
             elif mtype == "reserve_put":
                 oid = ObjectID.for_put().binary()
                 reply["object_id"] = oid
+            elif mtype == "device_put":
+                reply["object_id"] = self.reserve_device_put(handle)
+            elif mtype == "device_put_sealed":
+                self.seal_device_put(msg["object_id"])
             elif mtype == "put_sealed":
                 oid = msg["object_id"]
                 self.gcs.add_object_location(oid, handle.node_id)
@@ -1217,12 +1394,13 @@ class Runtime:
             node_id = handle.node_id
             nm = self.nodes[node_id]
             if not nm.store.contains(oid):
+                self._ensure_device_materialized(oid)
                 locs = [l for l in self.gcs.get_object_locations(oid)
                         if l != node_id and self.nodes.get(l)
                         and self.nodes[l].alive]
                 if locs:
                     self._transfer_object(oid, locs[0], node_id)
-                else:
+                elif not nm.store.contains(oid):
                     self._recover_object(oid)
                     # recovery may produce an inline value
                     with self._lock:
@@ -1276,6 +1454,8 @@ class Runtime:
     def shutdown(self) -> None:
         self._stop.set()
         self._wakeup()
+        if self._memory_monitor is not None:
+            self._memory_monitor.stop()
         try:
             self._listener.close()
         except OSError:

@@ -109,6 +109,11 @@ class WorkerRuntimeProxy:
         out: Dict[bytes, Any] = {}
         missing: List[bytes] = []
         for oid in set(oids):
+            # device objects pinned in THIS process come back zero-copy
+            arr = self._worker.device_store.get(oid)
+            if arr is not None:
+                out[oid] = arr
+                continue
             view = self._worker.store.get(oid)
             if view is not None:
                 out[oid] = self._worker.decode_value(view, pin=oid)
@@ -143,6 +148,22 @@ class WorkerRuntimeProxy:
         oid = reply["object_id"]
         self._worker.store.put_serialized(oid, data)
         self._request({"type": "put_sealed", "object_id": oid})
+        return oid
+
+    def put_device_object(self, value: Any) -> bytes:
+        """Pin a jax.Array in this worker's device store; two-phase with
+        the owner (reserve, store locally, seal) so a get racing the put
+        waits for the seal instead of missing the object."""
+        from .device_store import is_device_array
+
+        if not is_device_array(value):
+            raise TypeError(
+                "put(..., device=True) requires a jax.Array; got "
+                f"{type(value).__name__}")
+        reply = self._request({"type": "device_put"})
+        oid = reply["object_id"]
+        self._worker.device_store.put(oid, value)
+        self._request({"type": "device_put_sealed", "object_id": oid})
         return oid
 
     def put_serialized_arg(self, data) -> bytes:
@@ -232,10 +253,13 @@ class _ActorState:
 class Worker:
     def __init__(self, conn, worker_id: bytes, node_id: bytes,
                  store_name: str, inline_limit: int):
+        from .device_store import DeviceObjectStore
+
         self.conn = conn
         self.worker_id = worker_id
         self.node_id = node_id
         self.store = StoreClient(store_name)
+        self.device_store = DeviceObjectStore()
         self.inline_limit = inline_limit
         self.sender = _ReplySender(conn)
         self.proxy = WorkerRuntimeProxy(self)
@@ -378,6 +402,24 @@ class Worker:
         except Exception:
             return ser.dumps(TaskError(name, None, tb))
 
+    def materialize_device(self, msg: dict) -> None:
+        """Owner-side device→host copy on demand: serialize the pinned
+        array into this node's shm store so remote readers ride the
+        normal object plane (device_store.py design)."""
+        oid = msg["object_id"]
+        try:
+            arr = self.device_store.get(oid)
+            if arr is None:
+                raise KeyError(
+                    f"device object {oid.hex()} not pinned in this worker")
+            self.store.put_serialized(oid, ser.serialize(arr))
+            reply = {"type": "device_materialized", "object_id": oid,
+                     "error": None}
+        except BaseException as e:  # noqa: BLE001
+            reply = {"type": "device_materialized", "object_id": oid,
+                     "error": self._encode_error("materialize_device", e)}
+        self.sender.send(reply)
+
     def create_actor(self, msg: dict) -> None:
         actor_id = msg["actor_id"]
         try:
@@ -507,6 +549,14 @@ class Worker:
             mtype = msg["type"]
             if mtype == "exec":
                 self.task_executor.submit(self.exec_task, msg)
+            elif mtype == "materialize_device":
+                # own thread: queuing behind a long task on task_executor
+                # would stall remote readers of a live pinned object
+                threading.Thread(
+                    target=self.materialize_device, args=(msg,),
+                    daemon=True, name="materialize-device").start()
+            elif mtype == "free_device":
+                self.device_store.delete(msg["object_id"])
             elif mtype == "exec_actor":
                 state = self.actors.get(msg["actor_id"])
                 executor = state.executor if state else self.task_executor

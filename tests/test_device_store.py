@@ -1,0 +1,132 @@
+"""Device (HBM) object store: refs pinning live jax.Arrays.
+
+The BASELINE.json north-star capability — net-new vs the reference's
+host-only plasma. Covers: zero-copy same-process gets, on-demand
+device→host materialization for remote readers, device refs as task
+args, worker-owned device objects, free, and owner-death behavior.
+"""
+
+import numpy as np
+import pytest
+
+import ray_memory_management_tpu as rmt
+
+
+def _cpu_array(shape=(64,), seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+
+
+class TestDriverDeviceObjects:
+    def test_same_process_zero_copy(self, rmt_start_regular):
+        arr = _cpu_array()
+        ref = rmt.put(arr, device=True)
+        got = rmt.get(ref)
+        assert got is arr  # the SAME live array, not a copy
+
+    def test_requires_jax_array(self, rmt_start_regular):
+        with pytest.raises(TypeError):
+            rmt.put(np.zeros(4), device=True)
+
+    def test_task_consumes_device_ref(self, rmt_start_regular):
+        arr = _cpu_array(seed=1)
+        ref = rmt.put(arr, device=True)
+
+        @rmt.remote
+        def total(x):
+            return float(np.asarray(x).sum())
+
+        assert rmt.get(total.remote(ref)) == pytest.approx(
+            float(np.asarray(arr).sum()), rel=1e-5)
+
+    def test_free_on_ref_drop(self, rmt_start_regular):
+        rt = rmt_start_regular
+        arr = _cpu_array(seed=2)
+        ref = rmt.put(arr, device=True)
+        oid = ref.binary()
+        assert rt.device_store.contains(oid)
+        del ref
+        import gc
+
+        gc.collect()
+        assert not rt.device_store.contains(oid)
+
+
+class TestWorkerDeviceObjects:
+    def test_actor_pins_and_driver_reads(self, rmt_start_regular):
+        @rmt.remote
+        class Producer:
+            def make(self, n):
+                import jax.numpy as jnp
+
+                self.arr = jnp.arange(n, dtype=jnp.float32)
+                self.ref = rmt.put(self.arr, device=True)
+                return self.ref
+
+            def local_identity(self):
+                # same-process get returns the pinned array itself
+                return rmt.get(self.ref) is self.arr
+
+        p = Producer.remote()
+        ref = rmt.get(p.make.remote(8))
+        np.testing.assert_array_equal(
+            np.asarray(rmt.get(ref)), np.arange(8, dtype=np.float32))
+        assert rmt.get(p.local_identity.remote()) is True
+        rmt.kill(p)
+
+    def test_device_ref_between_workers(self, rmt_start_regular):
+        @rmt.remote
+        class Producer:
+            def make(self):
+                import jax.numpy as jnp
+
+                return rmt.put(jnp.full((16,), 3.0), device=True)
+
+        @rmt.remote
+        def consume(refs):
+            return float(np.asarray(rmt.get(refs[0])).sum())
+
+        p = Producer.remote()
+        ref = rmt.get(p.make.remote())
+        # wrapped in a list so the ref itself (not its value) ships
+        assert rmt.get(consume.remote([ref])) == pytest.approx(48.0)
+        rmt.kill(p)
+
+    def test_owner_death_loses_object(self, rmt_start_regular):
+        @rmt.remote
+        class Mortal:
+            def make(self):
+                import jax.numpy as jnp
+
+                return rmt.put(jnp.ones(4), device=True)
+
+        m = Mortal.remote()
+        ref = rmt.get(m.make.remote())
+        rmt.kill(m)
+        import time
+
+        time.sleep(0.5)  # let the death propagate
+        with pytest.raises(Exception):
+            rmt.get(ref, timeout=10)
+
+    def test_materialized_copy_survives_owner(self, rmt_start_regular):
+        """Once materialized to host shm, the object outlives its
+        device-owning process (the host copy is the spill tier)."""
+        @rmt.remote
+        class Owner:
+            def make(self):
+                import jax.numpy as jnp
+
+                return rmt.put(jnp.full((32,), 7.0), device=True)
+
+        o = Owner.remote()
+        ref = rmt.get(o.make.remote())
+        first = np.asarray(rmt.get(ref))  # forces materialization
+        rmt.kill(o)
+        import time
+
+        time.sleep(0.3)
+        np.testing.assert_array_equal(np.asarray(rmt.get(ref)), first)
