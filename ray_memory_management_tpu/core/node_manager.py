@@ -34,7 +34,8 @@ from .task_spec import TaskSpec
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "node_id", "ready", "idle",
                  "known_fns", "known_classes", "actor_id", "inflight",
-                 "lease_resources", "visible_chips", "pending_msgs")
+                 "lease_resources", "visible_chips", "pending_msgs",
+                 "_alive_checked_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -50,8 +51,21 @@ class WorkerHandle:
         self.lease_resources: Optional[Resources] = None
         self.visible_chips: Optional[List[int]] = None
         self.pending_msgs: List[dict] = []  # queued until registration
+        self._alive_checked_at = 0.0
 
     def alive(self) -> bool:
+        # proc.poll() is a waitpid syscall; on the dispatch hot path it
+        # dominated task throughput. Death is ALSO detected by the router
+        # seeing the pipe EOF, so a short-TTL cache here only delays this
+        # secondary check, never correctness.
+        if self.proc.returncode is not None:
+            return False
+        import time
+
+        now = time.monotonic()
+        if now - self._alive_checked_at < 0.2:
+            return True
+        self._alive_checked_at = now
         return self.proc.poll() is None
 
 

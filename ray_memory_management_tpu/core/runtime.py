@@ -138,6 +138,13 @@ class Runtime:
         for i, spec in enumerate(nodes_spec):
             self.add_node(spec, head=(i == 0))
 
+        self._send_cond = threading.Condition()
+        self._send_queues: Dict[Any, deque] = {}
+        self._send_draining: Set[Any] = set()
+        self._sender = threading.Thread(
+            target=self._sender_loop, daemon=True, name="rmt-sender"
+        )
+        self._sender.start()
         self._router = threading.Thread(
             target=self._router_loop, daemon=True, name="rmt-router"
         )
@@ -294,6 +301,73 @@ class Runtime:
             os.write(self._wakeup_w, b"x")
         except OSError:
             pass
+
+    # ---------------------------------------------------------- async sender
+    def _sender_enqueue(self, handle: WorkerHandle, msg: dict) -> bool:
+        """Send a task-dispatch message, batching under backlog: when the
+        connection is idle the message goes out inline (no handoff
+        latency); when sends are already in flight it queues for the
+        sender thread, which coalesces back-to-back dispatches into one
+        batch frame (one pickle+write). The per-conn 'draining' mark
+        keeps inline and threaded sends ordered."""
+        with self._lock:
+            if handle.conn is None:
+                if handle.alive():
+                    handle.pending_msgs.append(msg)
+                    return True
+                return False
+            conn = handle.conn
+        with self._send_cond:
+            q = self._send_queues.setdefault(conn, deque())
+            if q or conn in self._send_draining:
+                q.append((handle, msg))
+                self._send_cond.notify()
+                return True
+            self._send_draining.add(conn)  # reserve the idle fast path
+        ok = self._send_payload(conn, msg)
+        with self._send_cond:
+            self._send_draining.discard(conn)
+            if self._send_queues.get(conn):
+                self._send_cond.notify()
+        if not ok:
+            self._on_worker_death(handle)
+        return ok
+
+    def _send_payload(self, conn, payload: dict) -> bool:
+        lock = self._conn_send_locks.get(conn)
+        if lock is None:
+            return False
+        try:
+            with lock:
+                conn.send(payload)
+            return True
+        except (OSError, BrokenPipeError, ValueError):
+            return False
+
+    def _sender_loop(self) -> None:
+        while True:
+            with self._send_cond:
+                conn = batch = None
+                while conn is None:
+                    for c, q in self._send_queues.items():
+                        if q and c not in self._send_draining:
+                            conn, batch = c, list(q)
+                            q.clear()
+                            break
+                    if conn is None:
+                        if self._stop.is_set():
+                            return
+                        self._send_cond.wait(0.25)
+                self._send_draining.add(conn)
+            handle = batch[0][0]
+            msgs = [m for _, m in batch]
+            payload = msgs[0] if len(msgs) == 1 else {
+                "type": "batch", "msgs": msgs}
+            ok = self._send_payload(conn, payload)
+            with self._send_cond:
+                self._send_draining.discard(conn)
+            if not ok:
+                self._on_worker_death(handle)
 
     # ---------------------------------------------------------------- router
     def _router_loop(self) -> None:
@@ -566,7 +640,7 @@ class Runtime:
 
     def _send_task(self, handle: WorkerHandle, spec: TaskSpec) -> None:
         msg = self._task_msg(handle, spec)
-        if not self._send(handle, msg):
+        if not self._sender_enqueue(handle, msg):
             self._on_worker_death(handle)
 
     def _task_msg(self, handle: WorkerHandle, spec: TaskSpec) -> dict:
@@ -859,7 +933,7 @@ class Runtime:
                     self._fail_task(spec, TaskError(spec.name, e))
                     return
         handle.inflight[spec.task_id] = spec
-        if not self._send(handle, self._task_msg(handle, spec)):
+        if not self._sender_enqueue(handle, self._task_msg(handle, spec)):
             self._on_worker_death(handle)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
@@ -1454,6 +1528,8 @@ class Runtime:
     def shutdown(self) -> None:
         self._stop.set()
         self._wakeup()
+        with self._send_cond:
+            self._send_cond.notify_all()
         if self._memory_monitor is not None:
             self._memory_monitor.stop()
         try:

@@ -546,30 +546,37 @@ class Worker:
                 msg = self.conn.recv()
             except (EOFError, OSError):
                 break
-            mtype = msg["type"]
-            if mtype == "exec":
-                self.task_executor.submit(self.exec_task, msg)
-            elif mtype == "materialize_device":
-                # own thread: queuing behind a long task on task_executor
-                # would stall remote readers of a live pinned object
-                threading.Thread(
-                    target=self.materialize_device, args=(msg,),
-                    daemon=True, name="materialize-device").start()
-            elif mtype == "free_device":
-                self.device_store.delete(msg["object_id"])
-            elif mtype == "exec_actor":
-                state = self.actors.get(msg["actor_id"])
-                executor = state.executor if state else self.task_executor
-                executor.submit(self.exec_actor_task, msg)
-            elif mtype == "create_actor":
-                self.task_executor.submit(self.create_actor, msg)
-            elif mtype == "reply":
-                self.proxy.deliver(msg)
-            elif mtype == "ping":
-                self.sender.send({"type": "pong"})
-            elif mtype == "shutdown":
-                break
+            # batch frames come from the runtime's sender thread, which
+            # coalesces back-to-back dispatches into one pickle+write
+            msgs = msg["msgs"] if msg["type"] == "batch" else (msg,)
+            for m in msgs:
+                self._dispatch(m)
         os._exit(0)  # skip atexit: the store mapping may hold live views
+
+    def _dispatch(self, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == "exec":
+            self.task_executor.submit(self.exec_task, msg)
+        elif mtype == "exec_actor":
+            state = self.actors.get(msg["actor_id"])
+            executor = state.executor if state else self.task_executor
+            executor.submit(self.exec_actor_task, msg)
+        elif mtype == "create_actor":
+            self.task_executor.submit(self.create_actor, msg)
+        elif mtype == "reply":
+            self.proxy.deliver(msg)
+        elif mtype == "materialize_device":
+            # own thread: queuing behind a long task on task_executor
+            # would stall remote readers of a live pinned object
+            threading.Thread(
+                target=self.materialize_device, args=(msg,),
+                daemon=True, name="materialize-device").start()
+        elif mtype == "free_device":
+            self.device_store.delete(msg["object_id"])
+        elif mtype == "ping":
+            self.sender.send({"type": "pong"})
+        elif mtype == "shutdown":
+            self._shutdown.set()
 
 
 def worker_entry(conn, worker_id: bytes, node_id: bytes, store_name: str,

@@ -1,0 +1,18 @@
+"""Native store stress test (the C++-side race/lifecycle coverage; the
+sanitizer variants run via `make tsan` / `make asan` in native/)."""
+
+import os
+import subprocess
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ray_memory_management_tpu", "native")
+
+
+def test_native_stress_passes():
+    out = subprocess.run(
+        ["make", "check"], cwd=NATIVE_DIR,
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "STRESS OK" in out.stdout
+    subprocess.run(["make", "clean"], cwd=NATIVE_DIR, capture_output=True)
