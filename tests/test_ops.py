@@ -40,19 +40,33 @@ def test_flash_matches_reference(qkv, causal):
 
 
 def test_flash_multi_block(qkv):
-    # force blocking: block sizes smaller than S so the online-softmax loop
-    # actually runs multiple iterations
-    from ray_memory_management_tpu.ops.flash_attention import _flash_fwd
-
+    # force blocking: block sizes smaller than S so K/V stream through
+    # multiple grid steps and the online-softmax accumulators carry across
     q, k, v = qkv
-    B, H, S, D = q.shape
-    qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-    ref = reference_attention(qf, kf, vf, causal=True)
-    out = _flash_fwd(qf, kf, vf, causal=True, scale=D ** -0.5,
-                     block_q=32, block_k=32, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, use_pallas="interpret",
+                          block_q=32, block_k=32)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_multi_block_backward(qkv, causal):
+    # blockwise backward kernels (dq + dkv) vs jnp autodiff across blocks
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal,
+                                use_pallas="interpret",
+                                block_q=32, block_k=32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-3)
 
 
 def test_flash_gradient(qkv):
