@@ -170,16 +170,27 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
 
     x, _ = lax.scan(scan_body, x, params["layers"])
     x = _rmsnorm(x, params["final_ln"])
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    # bf16 operands on the MXU, fp32 accumulation/output — fp32 operands
+    # would run the largest matmul in the model at a fraction of MXU rate
+    logits = lax.dot_general(
+        x, params["lm_head"].astype(cfg.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     return logits
 
 
 def loss_fn(params, batch, cfg: TransformerConfig, mesh=None, sp_axis=None):
-    """batch: {"tokens": [B, S], "targets": [B, S]} -> mean xent."""
+    """batch: {"tokens": [B, S], "targets": [B, S]} -> mean xent.
+
+    Fused form: mean(logsumexp(logits) - logits[target]) — never
+    materialises log_softmax's [B, S, V] residual, which is the difference
+    between fitting batch 16 and OOMing on a 16 GB chip."""
     logits = forward(params, batch["tokens"], cfg, mesh, sp_axis)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    take = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)
-    return -jnp.mean(take)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    take = jnp.take_along_axis(logits, batch["targets"][..., None],
+                               axis=-1)[..., 0]
+    return jnp.mean(lse - take)
 
 
 def count_params(params) -> int:

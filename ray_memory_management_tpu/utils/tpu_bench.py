@@ -1,0 +1,207 @@
+"""TPU compute benchmarks: train-step MFU, flash-attention kernel, and
+collective bus-bandwidth.
+
+Measures the north-star rows of BASELINE.md ("match A100 DDP/NCCL") that the
+reference never publishes (its release tests assert completion, not
+throughput — release/release_logs/): the numbers must be measured, so this
+module measures them on whatever TPU is attached.
+
+Methodology note: on tunneled/remote TPU runtimes, ``block_until_ready`` can
+return before the computation finishes and per-dispatch round-trips run
+multiple milliseconds, so every timed region (a) runs its whole loop inside
+ONE jitted dispatch via ``lax.scan``/``fori_loop``, and (b) ends with a tiny
+device→host readback, which is the only reliable completion barrier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+# bf16 peak FLOPs/s per chip by device kind (public spec sheets)
+PEAK_BF16: Dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_BF16.items():
+        if kind.startswith(name):
+            return peak
+    return 197e12  # conservative default: v5e-class
+
+
+def on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _readback(x) -> float:
+    """Force completion: pull one scalar to the host."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
+def train_step_mfu(preset: str = "gpt2-small", batch_size: int = 8,
+                   seq_len: int = 1024, steps: int = 8) -> Dict[str, float]:
+    """Single-chip TransformerLM train step: tokens/s and model FLOPs
+    utilisation. Full fwd+bwd+AdamW, ``steps`` steps inside one dispatch."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from ..models import gpt
+
+    cfg = dataclasses.replace(gpt.PRESETS[preset], attention="flash",
+                              max_seq=seq_len)
+    key = jax.random.PRNGKey(0)
+    params = gpt.init_params(key, cfg)
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(key, (batch_size, seq_len), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    @jax.jit
+    def run(params, opt_state, batch):
+        def step(carry, _):
+            p, s = carry
+            loss, grads = jax.value_and_grad(
+                lambda p_: gpt.loss_fn(p_, batch, cfg))(p)
+            updates, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), loss
+
+        (p, s), losses = lax.scan(step, (params, opt_state), None,
+                                  length=steps)
+        return p, s, losses
+
+    p, s, losses = run(params, opt_state, batch)  # compile + warm
+    _readback(losses)
+    t0 = time.perf_counter()
+    _, _, losses = run(params, opt_state, batch)
+    final_loss = _readback(losses[-1:])
+    dt = time.perf_counter() - t0
+
+    n_params = gpt.count_params(params)
+    tokens_per_s = batch_size * seq_len * steps / dt
+    # PaLM-appendix accounting: 6N per token (fwd+bwd matmuls) plus causal
+    # attention 6*L*S*d_model per token (12*L*S*d non-causal, halved)
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq_len * cfg.d_model
+    mfu = tokens_per_s * flops_per_token / peak_flops(jax.devices()[0])
+    return {
+        "tokens_per_s": tokens_per_s,
+        "mfu": mfu,
+        "n_params": n_params,
+        "loss": final_loss,
+        "step_ms": dt / steps * 1e3,
+    }
+
+
+def flash_attention_bench(seq_lens=(1024, 4096, 8192), bh: int = 4,
+                          head_dim: int = 128,
+                          iters: int = 8) -> Dict[int, Dict[str, float]]:
+    """Flash kernel vs jnp reference, fwd+bwd, per sequence length.
+    Returns {S: {flash_ms, ref_ms, speedup}}."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.flash_attention import flash_attention, reference_attention
+
+    out: Dict[int, Dict[str, float]] = {}
+    for S in seq_lens:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (bh, S, head_dim), jnp.bfloat16)
+        k = jax.random.normal(kk, (bh, S, head_dim), jnp.bfloat16)
+        v = jax.random.normal(kv, (bh, S, head_dim), jnp.bfloat16)
+
+        def timed(attn_fn, n):
+            def loss(q_, k_, v_):
+                return jnp.sum(attn_fn(q_, k_, v_).astype(jnp.float32) ** 2)
+
+            grad = jax.grad(loss, argnums=(0, 1, 2))
+
+            @jax.jit
+            def run(q, k, v):
+                def body(i, carry):
+                    q_, acc = carry
+                    dq, dk, dv = grad(q_, k, v)
+                    # data-dependence across iterations so nothing is hoisted
+                    return (q_ + 1e-6 * dq.astype(q_.dtype),
+                            acc + jnp.sum(dv.astype(jnp.float32)))
+
+                return lax.fori_loop(0, n, body, (q, jnp.float32(0.0)))
+
+            _readback(run(q, k, v)[1])  # compile + warm
+            t0 = time.perf_counter()
+            _readback(run(q, k, v)[1])
+            return (time.perf_counter() - t0) / n * 1e3
+
+        n_ref = max(2, iters // 4) if S >= 8192 else iters
+        flash_ms = timed(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, use_pallas="on"),
+            iters)
+        ref_ms = timed(
+            lambda q_, k_, v_: reference_attention(q_, k_, v_), n_ref)
+        out[S] = {"flash_ms": flash_ms, "ref_ms": ref_ms,
+                  "speedup": ref_ms / flash_ms}
+    return out
+
+
+def allreduce_busbw(size_mb: int = 64,
+                    iters: int = 8) -> Optional[Dict[str, float]]:
+    """Bus bandwidth of a psum allreduce over all local TPU devices.
+    Returns None when fewer than 2 devices are attached (a single chip has
+    no interconnect to measure)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        return None
+    mesh = Mesh(np.array(devs), ("x",))
+    elems = size_mb * (1 << 20) // 4
+    x = jnp.ones((n, elems), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+    @jax.jit
+    def run(x):
+        def body(i, y):
+            from jax.experimental.shard_map import shard_map
+
+            f = shard_map(lambda a: lax.psum(a, "x"), mesh=mesh,
+                          in_specs=P("x", None), out_specs=P("x", None))
+            return f(y) / n  # keep magnitudes bounded
+
+        return lax.fori_loop(0, iters, body, x)
+
+    _readback(run(x))
+    t0 = time.perf_counter()
+    _readback(run(x))
+    dt = (time.perf_counter() - t0) / iters
+    bytes_moved = size_mb * (1 << 20)
+    # ring-allreduce bus bytes: 2*(n-1)/n per byte of payload
+    busbw = bytes_moved * 2 * (n - 1) / n / dt
+    return {"busbw_gbps": busbw / 1e9, "world": n,
+            "alg_bw_gbps": bytes_moved / dt / 1e9}
