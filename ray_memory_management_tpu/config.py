@@ -56,6 +56,22 @@ _flag("cpu_worker_env_drop", str, "PALLAS_AXON_POOL_IPS",
       "— accelerator-bootstrap triggers (sitecustomize TPU plugin init) "
       "that would cost seconds of spawn latency a CPU worker never needs.")
 
+# --- multi-host plane --------------------------------------------------------
+_flag("enable_node_listener", bool, True,
+      "Listen for node agents joining over TCP (the head side of the "
+      "multi-host plane; node_agent.py is the raylet-process analog).")
+_flag("node_listener_host", str, "127.0.0.1",
+      "Interface the node listener binds. Use 0.0.0.0 to accept agents "
+      "from other hosts.")
+_flag("node_listener_port", int, 0,
+      "Node listener port; 0 picks an ephemeral port.")
+
+_flag("gcs_storage_path", str, "",
+      "Durable GCS table storage (sqlite file). Empty = in-memory tables "
+      "that die with the driver; set a path and detached actors + cluster "
+      "KV survive head restarts (the Redis-FT analog, "
+      "redis_store_client.h:28).")
+
 # --- fault tolerance ---------------------------------------------------------
 _flag("num_heartbeats_timeout", int, 30,
       "Missed heartbeats before a node is declared dead "

@@ -81,14 +81,21 @@ class Pubsub:
 
 
 class GCS:
-    def __init__(self):
+    def __init__(self, storage=None):
+        from .gcs_storage import InMemoryGcsStorage
+
         self._lock = threading.RLock()
+        # pluggable table storage (gcs_storage.py — the Redis-FT analog,
+        # redis_store_client.h:28): durable backends persist the internal KV
+        # and detached-actor specs across head restarts
+        self.storage = storage or InMemoryGcsStorage()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.placement_groups: Dict[Any, Any] = {}
         self.jobs: Dict[Any, dict] = {}
-        self.kv: Dict[str, bytes] = {}
+        self.kv: Dict[str, bytes] = {
+            k: v for k, v in self.storage.items("kv")}
         self.pubsub = Pubsub()
         # object directory: object_id bytes -> set of NodeID with a sealed copy
         self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
@@ -171,8 +178,9 @@ class GCS:
 
     # -- kv ------------------------------------------------------------------
     def kv_put(self, key: str, value: bytes) -> None:
-        with self._lock:
-            self.kv[key] = value
+        with self._lock:  # storage write under the lock: persisted order
+            self.kv[key] = value  # must match in-memory order
+            self.storage.put("kv", key, value)
 
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -181,6 +189,7 @@ class GCS:
     def kv_del(self, key: str) -> None:
         with self._lock:
             self.kv.pop(key, None)
+            self.storage.delete("kv", key)
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:

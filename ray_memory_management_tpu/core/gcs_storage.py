@@ -1,0 +1,117 @@
+"""Persistent GCS table storage.
+
+The pluggable-store analog of the reference's GCS fault-tolerance tier
+(``InMemoryStoreClient`` vs ``RedisStoreClient``,
+src/ray/gcs/store_client/redis_store_client.h:28 — Redis-backed tables are
+what let detached actors and cluster KV survive a GCS restart). Here the
+durable backend is sqlite — single-file, transactional, no external server
+to manage, and good for the single-head control plane this runtime runs.
+
+Schema: one namespaced KV table. GCS tables (detached actors, internal KV,
+named placement groups) serialize rows into it under their own namespace.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class GcsStorage:
+    """Interface: namespaced binary KV with prefix listing."""
+
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, ns: str, key: str) -> None:
+        raise NotImplementedError
+
+    def items(self, ns: str) -> List[Tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryGcsStorage(GcsStorage):
+    """Default: tables die with the process (InMemoryStoreClient analog)."""
+
+    def __init__(self):
+        self._data: Dict[Tuple[str, str], bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[(ns, key)] = value
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get((ns, key))
+
+    def delete(self, ns: str, key: str) -> None:
+        with self._lock:
+            self._data.pop((ns, key), None)
+
+    def items(self, ns: str) -> List[Tuple[str, bytes]]:
+        with self._lock:
+            return [(k, v) for (n, k), v in self._data.items() if n == ns]
+
+
+class SqliteGcsStorage(GcsStorage):
+    """Durable tables in one sqlite file (RedisStoreClient analog)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS gcs_kv ("
+                " ns TEXT NOT NULL, key TEXT NOT NULL, value BLOB NOT NULL,"
+                " PRIMARY KEY (ns, key))"
+            )
+            self._conn.commit()
+
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO gcs_kv (ns, key, value) "
+                "VALUES (?, ?, ?)", (ns, key, value))
+            self._conn.commit()
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM gcs_kv WHERE ns = ? AND key = ?",
+                (ns, key)).fetchone()
+        return None if row is None else row[0]
+
+    def delete(self, ns: str, key: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM gcs_kv WHERE ns = ? AND key = ?", (ns, key))
+            self._conn.commit()
+
+    def items(self, ns: str) -> List[Tuple[str, bytes]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM gcs_kv WHERE ns = ?", (ns,)
+            ).fetchall()
+        return [(k, v) for k, v in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._conn.close()
+            except sqlite3.Error:
+                pass
+
+
+def open_storage(path: str) -> GcsStorage:
+    """'' -> volatile in-memory tables; a path -> durable sqlite tables."""
+    return SqliteGcsStorage(path) if path else InMemoryGcsStorage()

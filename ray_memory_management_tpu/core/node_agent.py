@@ -1,0 +1,346 @@
+"""Node agent: the per-host daemon of the multi-host plane.
+
+The raylet-process analog (the reference runs one raylet per node,
+src/ray/raylet/main.cc, joined to the head over gRPC — node registration
+src/ray/gcs/gcs_server/gcs_node_manager.h:36, object transfer
+src/ray/object_manager/object_manager.h:114). Run on each additional host:
+
+    python -m ray_memory_management_tpu.core.node_agent \
+        --address HEAD_HOST:PORT --authkey HEX [--num-cpus N] [--num-tpus N]
+
+Design: one authenticated TCP channel to the head carries EVERYTHING —
+worker-connection tunneling, task dispatch, chunked object push/pull, and
+liveness. The agent owns the host-local pieces a kernel boundary forces:
+the shared-memory object store and the worker process pool. All ownership,
+scheduling, and object-directory state stays at the head (centralized
+ownership is this runtime's single-driver simplification; the tunnel keeps
+every existing head-side code path — dispatch, nested worker requests,
+actor lifecycles — working unchanged for remote workers).
+
+Channel frames, head -> agent:
+    start_worker {wid, dedicated, env}     spawn a worker process
+    wsend       {wid, msg}                 deliver msg to worker wid
+    kill_worker {wid}                      terminate a worker process
+    obj_push    {oid, size}                begin receiving an object
+    obj_chunk   {oid, off, data}           one chunk of it
+    obj_seal    {oid, req}                 seal; reply push_ack
+    obj_pull    {oid, req}                 stream the object back
+    obj_free    {oid}                      drop from the local store
+    ping                                   liveness probe
+    shutdown                               stop workers, close store, exit
+
+agent -> head:
+    register_node {...}                    hello (first frame)
+    wmsg        {wid, msg}                 tunneled worker message
+    wdeath      {wid}                      worker pipe EOF
+    push_ack    {req, error}               object landed (or failed)
+    pull_data   {req, off, data, eof, error}
+    pong
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from ..config import Config
+from .object_store import NodeObjectStore
+
+
+def _reap_stale_agent_stores() -> None:
+    """A SIGKILLed agent cannot unlink its shm store; reclaim segments whose
+    owning pid (embedded in the name) is gone. Runs at agent start so a
+    crash-looping host converges instead of filling /dev/shm."""
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("rmtA_"):
+            continue
+        try:
+            pid = int(name.split("_")[1])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(f"/dev/shm/{name}")
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # pid alive under another uid
+
+
+class NodeAgent:
+    def __init__(self, head_host: str, head_port: int, authkey: bytes,
+                 num_cpus: int, num_tpus: int = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        from multiprocessing.connection import Client, Listener
+
+        self.channel = Client((head_host, head_port), authkey=authkey)
+        self._channel_lock = threading.Lock()
+        self._send({
+            "type": "register_node",
+            "num_cpus": num_cpus,
+            "num_tpus": num_tpus,
+            "resources": resources or {},
+            "labels": labels or {},
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+        })
+        hello = self.channel.recv()
+        if hello.get("type") != "registered":
+            raise RuntimeError(f"head rejected registration: {hello}")
+        self.node_id: bytes = hello["node_id"]
+        self.config = Config(**hello["config"])
+        self.inline_limit = self.config.max_direct_call_object_size
+
+        _reap_stale_agent_stores()
+        self.store_name = f"/rmtA_{os.getpid()}_{os.urandom(4).hex()}"
+        self.store = NodeObjectStore(self.store_name, self.config,
+                                     create=True)
+        self._push_bufs: Dict[bytes, memoryview] = {}
+
+        self._authkey = os.urandom(16)
+        self._socket_path = f"/tmp/rmtA_{os.getpid()}_{os.urandom(4).hex()}.sock"
+        self._listener = Listener(self._socket_path, family="AF_UNIX",
+                                  authkey=self._authkey)
+        self._workers: Dict[bytes, Any] = {}        # wid -> conn
+        self._worker_procs: Dict[bytes, Any] = {}   # wid -> Popen
+        self._worker_send_locks: Dict[bytes, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="agent-accept").start()
+
+    # ---------------------------------------------------------------- channel
+    def _send(self, msg: dict) -> None:
+        with self._channel_lock:
+            self.channel.send(msg)
+
+    # ---------------------------------------------------------------- workers
+    def _accept_loop(self) -> None:
+        """Local workers dial in exactly as they would dial a head-local
+        runtime (worker_main.py is unchanged); their frames are tunneled."""
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if msg.get("type") != "ready":
+                conn.close()
+                continue
+            wid = msg["worker_id"]
+            with self._lock:
+                self._workers[wid] = conn
+                self._worker_send_locks[wid] = threading.Lock()
+            self._send({"type": "wmsg", "wid": wid, "msg": msg})
+            threading.Thread(target=self._worker_reader, args=(wid, conn),
+                             daemon=True, name="agent-wreader").start()
+
+    def _worker_reader(self, wid: bytes, conn) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._send({"type": "wmsg", "wid": wid, "msg": msg})
+            except (OSError, BrokenPipeError):
+                return  # channel gone: the process is shutting down
+        with self._lock:
+            self._workers.pop(wid, None)
+            self._worker_send_locks.pop(wid, None)
+        try:
+            self._send({"type": "wdeath", "wid": wid})
+        except (OSError, BrokenPipeError):
+            pass
+
+    def _start_worker(self, msg: dict) -> None:
+        wid_hex = msg["wid_hex"]
+        env = dict(os.environ)
+        env.update(msg.get("env") or {})
+        env.update({
+            "RMT_WORKER_ID": wid_hex,
+            "RMT_NODE_ID": self.node_id.hex(),
+            "RMT_STORE_NAME": self.store_name,
+            "RMT_SOCKET": self._socket_path,
+            "RMT_AUTHKEY": self._authkey.hex(),
+            "RMT_INLINE_LIMIT": str(self.inline_limit),
+            "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
+        })
+        if env["JAX_PLATFORMS"] == "cpu":
+            for var in self.config.cpu_worker_env_drop.split(","):
+                if var:
+                    env.pop(var.strip(), None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "ray_memory_management_tpu.core.worker_main"],
+            env=env, close_fds=True,
+        )
+        with self._lock:
+            self._worker_procs[bytes.fromhex(wid_hex)] = proc
+
+    # ----------------------------------------------------------- object plane
+    def _obj_push(self, msg: dict) -> None:
+        oid = msg["oid"]
+        if oid in self._push_bufs:
+            return  # an identical push is mid-flight; let it finish
+        try:
+            self._push_bufs[oid] = self.store.create(oid, msg["size"])
+        except ValueError:
+            pass  # already sealed in the store: ignore this push's chunks
+
+    def _obj_chunk(self, msg: dict) -> None:
+        buf = self._push_bufs.get(msg["oid"])
+        if buf is not None:
+            off = msg["off"]
+            data = msg["data"]
+            buf[off:off + len(data)] = data
+
+    def _obj_seal(self, msg: dict) -> None:
+        oid = msg["oid"]
+        err = None
+        if oid in self._push_bufs:
+            del self._push_bufs[oid]
+            try:
+                self.store.seal(oid)
+            except Exception as e:  # noqa: BLE001
+                err = repr(e)
+        elif not self.store.contains(oid):
+            # this push's create was refused and nobody else sealed it:
+            # acking success would poison the head's object directory
+            err = "push raced an incomplete object"
+        self._send({"type": "push_ack", "req": msg["req"], "error": err})
+
+    def _obj_pull(self, msg: dict) -> None:
+        oid, req = msg["oid"], msg["req"]
+        view = self.store.get(oid)
+        if view is None:
+            self._send({"type": "pull_data", "req": req, "off": 0,
+                        "data": b"", "eof": True,
+                        "error": "object not in store"})
+            return
+        try:
+            chunk = self.config.object_manager_chunk_size
+            n = view.nbytes
+            if n == 0:
+                self._send({"type": "pull_data", "req": req, "off": 0,
+                            "data": b"", "eof": True, "error": None})
+                return
+            for off in range(0, n, chunk):
+                end = min(off + chunk, n)
+                self._send({
+                    "type": "pull_data", "req": req, "off": off,
+                    "data": bytes(view[off:end]), "eof": end >= n,
+                    "error": None,
+                })
+        finally:
+            self.store.release(oid)
+
+    # ------------------------------------------------------------------- main
+    def run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            self._shutdown()
+
+    def _run_loop(self) -> None:
+        while True:
+            try:
+                msg = self.channel.recv()
+            except (EOFError, OSError):
+                return  # head gone: shut down this node
+            t = msg["type"]
+            if t == "wsend":
+                wid = msg["wid"]
+                with self._lock:
+                    conn = self._workers.get(wid)
+                    lock = self._worker_send_locks.get(wid)
+                if conn is not None and lock is not None:
+                    try:
+                        with lock:
+                            conn.send(msg["msg"])
+                    except (OSError, BrokenPipeError, ValueError):
+                        pass  # reader thread will report wdeath
+            elif t == "start_worker":
+                self._start_worker(msg)
+            elif t == "kill_worker":
+                proc = self._worker_procs.get(msg["wid"])
+                if proc is not None:
+                    try:
+                        proc.terminate()
+                    except Exception:
+                        pass
+            elif t == "obj_push":
+                self._obj_push(msg)
+            elif t == "obj_chunk":
+                self._obj_chunk(msg)
+            elif t == "obj_seal":
+                self._obj_seal(msg)
+            elif t == "obj_pull":
+                self._obj_pull(msg)
+            elif t == "obj_free":
+                try:
+                    self.store.delete(msg["oid"])
+                except Exception:
+                    pass
+            elif t == "ping":
+                self._send({"type": "pong"})
+            elif t == "shutdown":
+                return
+
+    def _shutdown(self) -> None:
+        self._stop.set()
+        for proc in list(self._worker_procs.values()):
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self._socket_path)
+        except OSError:
+            pass
+        self.store.close(unlink=True)
+        try:
+            self.channel.close()
+        except Exception:
+            pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="rmt node agent")
+    p.add_argument("--address", required=True,
+                   help="head node listener, HOST:PORT")
+    p.add_argument("--authkey", required=True, help="hex cluster authkey")
+    p.add_argument("--num-cpus", type=int, default=4)
+    p.add_argument("--num-tpus", type=int, default=0)
+    args = p.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    agent = NodeAgent(host, int(port), bytes.fromhex(args.authkey),
+                      num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    agent.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

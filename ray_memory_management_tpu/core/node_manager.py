@@ -236,7 +236,11 @@ class NodeManager:
                 handle.visible_chips = None
             if handle.actor_id is None and handle.alive():
                 handle.idle = True
-                self.idle_workers.append(handle)
+                # LIFO: reuse the hottest worker — on small tasks this keeps
+                # one process warm (caches, branch state) and lets dispatch
+                # batches coalesce on its pipe instead of round-robining
+                # wakeups across the whole pool
+                self.idle_workers.appendleft(handle)
 
     def dedicate_to_actor(self, handle: WorkerHandle, actor_id: bytes,
                           req: Resources, chips: Optional[List[int]]) -> None:

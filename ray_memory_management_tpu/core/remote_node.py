@@ -1,0 +1,287 @@
+"""Head-side proxy for a remote node joined through a node agent.
+
+``RemoteNodeManager`` subclasses ``NodeManager`` so every head-side code
+path — scheduling, lease accounting, dispatch, actor lifecycle, worker
+death — treats remote nodes exactly like local ones. What differs is the
+mechanics a kernel boundary forces:
+
+  - workers are spawned by the agent (``start_worker`` sends a frame
+    instead of fork/exec; the handle's ``proc`` is a :class:`RemoteProc`);
+  - worker pipes are tunneled: the handle's ``conn`` is a
+    :class:`VirtualConn` whose ``send`` wraps the payload in a
+    ``wsend`` frame on the agent channel, and inbound worker frames are
+    unwrapped by the runtime's router (``wmsg``);
+  - the object store is remote: :class:`RemoteStoreProxy` implements the
+    read side by streaming chunks over the channel (the reference's
+    chunked object-manager pull, object_manager.proto:63-67) and the
+    write side by streaming a push (ObjectManager::Push analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..config import Config
+from ..ids import NodeID, WorkerID
+from .node_manager import NodeManager, WorkerHandle
+from .resources import NodeResources
+
+
+class VirtualConn:
+    """Stand-in for a worker's pipe: sends ride the agent channel."""
+
+    __slots__ = ("wid", "node")
+
+    def __init__(self, wid: bytes, node: "RemoteNodeManager"):
+        self.wid = wid
+        self.node = node
+
+    def send(self, payload: dict) -> None:
+        self.node.channel_send({"type": "wsend", "wid": self.wid,
+                                "msg": payload})
+
+    def close(self) -> None:
+        pass
+
+
+class RemoteProc:
+    """Popen-shaped liveness facade for a worker living on another host.
+    Death is learned from the agent (``wdeath``) rather than waitpid."""
+
+    __slots__ = ("returncode", "_node", "_wid")
+
+    def __init__(self, node: "RemoteNodeManager", wid: bytes):
+        self.returncode: Optional[int] = None
+        self._node = node
+        self._wid = wid
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self) -> None:
+        self._node.channel_send({"type": "kill_worker", "wid": self._wid})
+
+    def kill(self) -> None:
+        self.terminate()
+
+
+class RemoteStoreProxy:
+    """The store surface the runtime needs for a node it cannot mmap.
+
+    ``contains`` answers from the head's object directory (GCS locations —
+    the head is the owner of record, so directory state is authoritative);
+    ``get`` pulls the object's bytes over the channel; pushes stream
+    create/chunk/seal frames and wait for the agent's ack.
+    """
+
+    def __init__(self, node: "RemoteNodeManager"):
+        self._node = node
+
+    def contains(self, object_id: bytes) -> bool:
+        gcs = self._node.gcs
+        return (gcs is not None
+                and self._node.node_id in gcs.get_object_locations(object_id))
+
+    def get(self, object_id: bytes):
+        data = self._node.pull_object(object_id)
+        return None if data is None else memoryview(data)
+
+    def release(self, object_id: bytes) -> None:
+        pass  # pulled bytes are owned by the head-side caller
+
+    def delete(self, object_id: bytes) -> None:
+        self._node.channel_send({"type": "obj_free", "oid": object_id})
+
+    def put_serialized(self, object_id: bytes, serialized) -> None:
+        buf = bytearray(serialized.total_size)
+        serialized.write_into(memoryview(buf))
+        self._node.push_object(object_id, memoryview(buf))
+
+    def usage(self):
+        return (0, 0)
+
+
+class RemoteNodeManager(NodeManager):
+    def __init__(self, node_id: NodeID, resources: NodeResources,
+                 config: Config, on_worker_started, channel,
+                 gcs=None, hostname: str = "?"):
+        # NodeManager.__init__ would create a local shm store; bypass it and
+        # wire the remote-facing fields directly.
+        self.socket_path = ""
+        self.authkey_hex = ""
+        self.node_id = node_id
+        self.resources = resources
+        self.config = config
+        self.store = RemoteStoreProxy(self)
+        self.store_name = f"remote:{hostname}"
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        from collections import deque
+
+        self.idle_workers = deque()
+        self.queue = deque()
+        self.starting = 0
+        self.alive = True
+        self._on_worker_started = on_worker_started
+        self._lock = threading.RLock()
+        from .resources import TPU
+
+        total_chips = int(resources.total.get(TPU))
+        self.free_chips = list(range(total_chips))
+
+        self.channel = channel
+        self.gcs = gcs
+        self.hostname = hostname
+        self._channel_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending: Dict[int, dict] = {}       # req -> accumulating state
+        self._pending_lock = threading.Lock()
+        # serializes pushes so two transfer threads never interleave
+        # create/chunk/seal frames for the same object at the agent
+        self._push_lock = threading.Lock()
+
+    # ---------------------------------------------------------------- channel
+    def channel_send(self, msg: dict) -> bool:
+        try:
+            with self._channel_lock:
+                self.channel.send(msg)
+            return True
+        except (OSError, BrokenPipeError, ValueError):
+            return False
+
+    def _new_req(self) -> int:
+        with self._pending_lock:
+            self._req_counter += 1
+            req = self._req_counter
+            self._pending[req] = {"event": threading.Event(), "chunks": [],
+                                  "error": None}
+            return req
+
+    # -------------------------------------------------------------- transfers
+    def pull_object(self, object_id: bytes,
+                    timeout: float = 120.0) -> Optional[bytes]:
+        """Chunked pull over the channel (PullManager analog,
+        pull_manager.h:47, collapsed to one in-order stream)."""
+        if not self.alive:
+            return None
+        req = self._new_req()
+        with self._pending_lock:
+            state = self._pending.get(req)
+        if state is None or not self.channel_send(
+                {"type": "obj_pull", "oid": object_id, "req": req}):
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return None
+        if not state["event"].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return None
+        with self._pending_lock:
+            self._pending.pop(req, None)
+        if state["error"]:
+            return None
+        return b"".join(state["chunks"])
+
+    def push_object(self, object_id: bytes, view: memoryview,
+                    timeout: float = 120.0) -> bool:
+        """Chunked push (ObjectManager::Push analog)."""
+        if not self.alive:
+            return False
+        with self._push_lock:
+            # a concurrent transfer may have landed this object already
+            if self.gcs is not None and self.node_id in \
+                    self.gcs.get_object_locations(object_id):
+                return True
+            req = self._new_req()
+            with self._pending_lock:
+                state = self._pending.get(req)
+            if state is None:
+                return False
+            chunk = self.config.object_manager_chunk_size
+            ok = self.channel_send({"type": "obj_push", "oid": object_id,
+                                    "size": view.nbytes})
+            for off in range(0, view.nbytes, chunk):
+                if not ok:
+                    break
+                end = min(off + chunk, view.nbytes)
+                ok = self.channel_send({
+                    "type": "obj_chunk", "oid": object_id, "off": off,
+                    "data": bytes(view[off:end]),
+                })
+            ok = ok and self.channel_send(
+                {"type": "obj_seal", "oid": object_id, "req": req})
+            if not ok:
+                with self._pending_lock:
+                    self._pending.pop(req, None)
+                return False
+            if not state["event"].wait(timeout):
+                with self._pending_lock:
+                    self._pending.pop(req, None)
+                return False
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return state["error"] is None
+
+    def on_channel_reply(self, msg: dict) -> None:
+        """push_ack / pull_data frames routed here by the runtime router."""
+        req = msg.get("req")
+        with self._pending_lock:
+            state = self._pending.get(req)
+        if state is None:
+            return
+        if msg["type"] == "push_ack":
+            state["error"] = msg.get("error")
+            state["event"].set()
+            return
+        if msg.get("error"):
+            state["error"] = msg["error"]
+            state["event"].set()
+            return
+        state["chunks"].append(msg["data"])
+        if msg.get("eof"):
+            state["event"].set()
+
+    # ------------------------------------------------------------ worker pool
+    def start_worker(self, dedicated: bool = False) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        self.channel_send({
+            "type": "start_worker",
+            "wid_hex": worker_id.hex(),
+            "dedicated": dedicated,
+            "env": {},
+        })
+        handle = WorkerHandle(worker_id, RemoteProc(self, worker_id.binary()),
+                              self.node_id)
+        if dedicated:
+            handle.actor_id = b"__pending__"
+        with self._lock:
+            self.workers[worker_id] = handle
+            if not dedicated:
+                self.starting += 1
+        self._on_worker_started(handle)
+        return handle
+
+    def worker_by_wid(self, wid: bytes) -> Optional[WorkerHandle]:
+        with self._lock:
+            return self.workers.get(WorkerID(wid))
+
+    def mark_dead(self) -> None:
+        self.alive = False
+        # wake every transfer waiting on this channel
+        with self._pending_lock:
+            for state in self._pending.values():
+                state["error"] = "node died"
+                state["event"].set()
+            self._pending.clear()
+        for h in self.workers.values():
+            if isinstance(h.proc, RemoteProc):
+                h.proc.returncode = 1
+
+    def shutdown(self, unlink_store: bool = True) -> None:
+        self.channel_send({"type": "shutdown"})
+        self.alive = False
+        try:
+            self.channel.close()
+        except Exception:
+            pass

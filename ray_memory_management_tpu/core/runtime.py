@@ -82,8 +82,11 @@ class Runtime:
         self.config = config
         self.job_id = JobID.from_random()
         self.namespace = namespace or f"rmt_{os.getpid()}_{id(self) & 0xffff}"
-        self.gcs = GCS()
-        self.scheduler = ClusterScheduler(self.gcs, config)
+        from .gcs_storage import open_storage
+
+        self.gcs = GCS(open_storage(config.gcs_storage_path))
+        self.scheduler = ClusterScheduler(
+            self.gcs, config, load_fn=self._node_queue_depth)
         self.nodes: Dict[NodeID, NodeManager] = {}
         self._store_clients: Dict[NodeID, StoreClient] = {}
         self._head_node_id: Optional[NodeID] = None
@@ -110,6 +113,8 @@ class Runtime:
 
         self._lock = threading.RLock()
         self._conn_handles: Dict[Any, WorkerHandle] = {}
+        self._router_adds: List[Any] = []  # conns awaiting selector register
+        self._router_removals: List[Any] = []  # closed conns to unregister
         self._request_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="rmt-serve"
         )
@@ -134,6 +139,27 @@ class Runtime:
             target=self._accept_loop, daemon=True, name="rmt-accept"
         )
         self._accept_thread.start()
+
+        # multi-host plane: TCP listener for node agents (node_agent.py) —
+        # the head side of the raylet-joins-GCS handshake
+        self._agent_nodes: Dict[Any, Any] = {}  # channel conn -> RemoteNodeManager
+        self._node_listener = None
+        self._node_listener_thread = None
+        self.node_listener_address: Optional[Tuple[str, int]] = None
+        self._agent_procs: List[Any] = []  # agents spawned by this driver
+        if config.enable_node_listener:
+            from multiprocessing.connection import Listener as _TCPListener
+
+            self._node_listener = _TCPListener(
+                (config.node_listener_host, config.node_listener_port),
+                family="AF_INET", authkey=self._authkey,
+            )
+            self.node_listener_address = self._node_listener.address
+            self._node_listener_thread = threading.Thread(
+                target=self._agent_accept_loop, daemon=True,
+                name="rmt-node-accept",
+            )
+            self._node_listener_thread.start()
 
         for i, spec in enumerate(nodes_spec):
             self.add_node(spec, head=(i == 0))
@@ -165,6 +191,8 @@ class Runtime:
             self._memory_monitor.start()
         for nm in self.nodes.values():
             nm.prestart()
+        if config.gcs_storage_path:
+            self._recreate_detached_actors()
         # best-effort cleanup if the driver exits without shutdown(): shm
         # stores are kernel objects and would otherwise outlive the process
         import atexit
@@ -205,6 +233,8 @@ class Runtime:
             if nm is None:
                 return
             nm.alive = False
+            if hasattr(nm, "mark_dead"):  # remote: wake pending transfers
+                nm.mark_dead()
             self.gcs.mark_node_dead(node_id)
             requeue = list(nm.queue)
             nm.queue.clear()
@@ -223,14 +253,23 @@ class Runtime:
     def head_node(self) -> NodeManager:
         return self.nodes[self._head_node_id]
 
+    def _node_queue_depth(self, node_id: NodeID) -> int:
+        nm = self.nodes.get(node_id)
+        return len(nm.queue) if nm is not None else 0
+
     def _store_client_for(self, node_id: NodeID) -> StoreClient:
-        # Same-host: the driver can map any node's store directly. Multi-host
-        # would pull over the DCN object plane instead (object_manager.proto).
+        # Same-host nodes: the driver maps the store directly (one kernel).
+        # Remote nodes: reads ride the chunked DCN object plane through the
+        # node's agent channel (object_manager.proto:63-67 analog).
         with self._lock:
             cli = self._store_clients.get(node_id)
             if cli is None:
                 nm = self.nodes[node_id]
-                if nm is self.head_node():
+                from .remote_node import RemoteNodeManager
+
+                if isinstance(nm, RemoteNodeManager):
+                    cli = nm.store  # RemoteStoreProxy
+                elif nm is self.head_node():
                     # reuse the node's own mapping
                     cli = nm.store
                 else:
@@ -269,6 +308,7 @@ class Runtime:
                 handle.conn = conn
                 self._conn_handles[conn] = handle
                 self._conn_send_locks[conn] = threading.Lock()
+                self._router_adds.append(conn)
                 pending = list(handle.pending_msgs)
                 handle.pending_msgs.clear()
             nm = self.nodes.get(handle.node_id)
@@ -278,6 +318,151 @@ class Runtime:
                 self._send(handle, m)
             self._wakeup()
             self._pump()
+
+    # ------------------------------------------------------------ node agents
+    def _agent_accept_loop(self) -> None:
+        """Admit node agents joining over TCP (GcsNodeManager::HandleRegister
+        analog, gcs_node_manager.h:36): read the hello, create the head-side
+        RemoteNodeManager, and hand the channel to the router."""
+        from .remote_node import RemoteNodeManager
+
+        while not self._stop.is_set():
+            try:
+                conn = self._node_listener.accept()
+            except (OSError, EOFError):
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if msg.get("type") != "register_node":
+                conn.close()
+                continue
+            node_id = NodeID.from_random()
+            res = task_resources(
+                num_cpus=msg.get("num_cpus", 4),
+                num_tpus=msg.get("num_tpus", 0),
+                resources=msg.get("resources"),
+                default_cpus=msg.get("num_cpus", 4),
+            )
+            node_res = NodeResources(res)
+            nm = RemoteNodeManager(
+                node_id, node_res, self.config,
+                on_worker_started=self._register_worker,
+                channel=conn, gcs=self.gcs,
+                hostname=msg.get("hostname", "?"),
+            )
+            try:
+                conn.send({
+                    "type": "registered",
+                    "node_id": node_id.binary(),
+                    "config": self.config.to_dict(),
+                })
+            except (OSError, BrokenPipeError):
+                conn.close()
+                continue
+            with self._lock:
+                self.nodes[node_id] = nm
+                self.gcs.register_node(node_id, node_res, nm.store_name,
+                                       msg.get("labels"))
+                self._agent_nodes[conn] = nm
+                self._router_adds.append(conn)
+            nm.prestart()
+            self._wakeup()
+
+    def _handle_agent_message(self, nm, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == "wmsg":
+            handle = nm.worker_by_wid(msg["wid"])
+            if handle is None:
+                return
+            inner = msg["msg"]
+            if inner.get("type") == "ready":
+                self._bind_remote_worker(nm, handle)
+                return
+            self._handle_worker_message(handle, inner)
+        elif mtype in ("push_ack", "pull_data"):
+            nm.on_channel_reply(msg)
+        elif mtype == "wdeath":
+            handle = nm.worker_by_wid(msg["wid"])
+            if handle is not None:
+                if handle.proc.returncode is None:
+                    handle.proc.returncode = 1
+                self._on_worker_death(handle)
+        elif mtype == "pong":
+            pass
+
+    def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
+        from .remote_node import VirtualConn
+
+        vconn = VirtualConn(handle.worker_id.binary(), nm)
+        with self._lock:
+            handle.conn = vconn
+            self._conn_handles[vconn] = handle
+            self._conn_send_locks[vconn] = threading.Lock()
+            pending = list(handle.pending_msgs)
+            handle.pending_msgs.clear()
+        nm.on_worker_ready(handle)
+        for m in pending:
+            self._send(handle, m)
+        self._pump()
+
+    def _on_agent_death(self, nm) -> None:
+        """The agent channel broke: the whole remote node is gone (node
+        death via heartbeat timeout / connection loss — NodeManager death
+        handling, gcs_node_manager.h)."""
+        with self._lock:
+            if not nm.alive:
+                return
+            nm.mark_dead()
+            self.gcs.mark_node_dead(nm.node_id)
+            requeue = list(nm.queue)
+            nm.queue.clear()
+            workers = list(nm.workers.values())
+        for h in workers:
+            self._on_worker_death(h)
+        for spec in requeue:
+            self._schedule(spec)
+        self.gcs.drop_node_objects(nm.node_id)
+        self._wakeup()
+
+    def add_remote_node_process(self, num_cpus: int = 4, num_tpus: int = 0,
+                                timeout: float = 30.0) -> NodeID:
+        """Spawn a node-agent subprocess joined to this head — the in-repo
+        stand-in for ``rmt start --address`` on another host (and the test
+        vehicle for the multi-host plane: the agent shares NOTHING with the
+        head but the TCP channel)."""
+        import subprocess
+        import sys as _sys
+
+        if self.node_listener_address is None:
+            raise RuntimeError("node listener disabled by config")
+        host, port = self.node_listener_address
+        before = set(self.nodes)
+        proc = subprocess.Popen(
+            [_sys.executable, "-m",
+             "ray_memory_management_tpu.core.node_agent",
+             "--address", f"{host}:{port}",
+             "--authkey", self._authkey.hex(),
+             "--num-cpus", str(num_cpus),
+             "--num-tpus", str(num_tpus)],
+            close_fds=True,
+        )
+        self._agent_procs.append(proc)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                new = [n for n in self.nodes if n not in before]
+            if new:
+                return new[0]
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node agent exited rc={proc.returncode} before joining")
+            time.sleep(0.05)
+        raise TimeoutError("node agent did not register in time")
 
     def _send(self, handle: WorkerHandle, msg: dict) -> bool:
         with self._lock:
@@ -371,15 +556,84 @@ class Runtime:
 
     # ---------------------------------------------------------------- router
     def _router_loop(self) -> None:
+        """Single receive loop over all worker pipes.
+
+        Uses one persistent epoll-backed selector: rebuilding a poll set per
+        iteration (``multiprocessing.connection.wait``) costs ~100 us per
+        round with tens of fds, which at high task rates was the single
+        largest driver-side line item. Selectors are not thread-safe, so
+        registration changes ride ``_router_adds`` and are applied here.
+        """
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        sel.register(self._wakeup_r, selectors.EVENT_READ, None)
+        registered: Dict[Any, Any] = {}
+
+        def unregister(r) -> None:
+            try:
+                sel.unregister(r)
+            except (KeyError, ValueError):
+                pass
+            registered.pop(r, None)
+
+        def drain(r, on_msg, on_eof) -> None:
+            # drain a bounded burst from this conn before moving on, so one
+            # chatty peer cannot starve the others
+            for _ in range(64):
+                try:
+                    msg = r.recv()
+                except (EOFError, OSError):
+                    unregister(r)
+                    on_eof()
+                    return
+                on_msg(msg)
+                try:
+                    if not r.poll(0):
+                        return
+                except (OSError, ValueError):
+                    return
+
         while not self._stop.is_set():
             with self._lock:
-                conns = list(self._conn_handles.keys())
+                adds = self._router_adds
+                self._router_adds = []
+                removals = self._router_removals
+                self._router_removals = []
+            for conn in removals:
+                # conns closed outside the router (death by failed send)
+                # must leave the selector HERE: a closed-but-registered fd
+                # number can be reused by a new worker's pipe
+                unregister(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for conn in adds:
+                if conn not in registered and (
+                        conn in self._conn_handles
+                        or conn in self._agent_nodes):
+                    try:
+                        registered[conn] = sel.register(
+                            conn, selectors.EVENT_READ, None)
+                    except KeyError:
+                        # fd number reused while a stale entry lingers:
+                        # evict it and retry once
+                        unregister(conn)
+                        try:
+                            registered[conn] = sel.register(
+                                conn, selectors.EVENT_READ, None)
+                        except (ValueError, KeyError, OSError):
+                            pass
+                    except (ValueError, OSError):
+                        pass
             try:
-                ready = mpc.wait(conns + [self._wakeup_r], timeout=0.25)
+                events = sel.select(timeout=0.25)
             except OSError:
                 time.sleep(0.01)
                 continue
-            for r in ready:
+            for key, _ in events:
+                r = key.fileobj
                 if r == self._wakeup_r:
                     try:
                         os.read(self._wakeup_r, 4096)
@@ -387,18 +641,29 @@ class Runtime:
                         pass
                     continue
                 handle = self._conn_handles.get(r)
-                if handle is None:
+                if handle is not None:
+                    drain(r,
+                          lambda m, h=handle: self._handle_worker_message(h, m),
+                          lambda h=handle: self._on_worker_death(h))
                     continue
-                try:
-                    msg = r.recv()
-                except (EOFError, OSError):
-                    self._on_worker_death(handle)
+                nm = self._agent_nodes.get(r)
+                if nm is not None:
+                    def agent_eof(nm=nm, r=r):
+                        self._agent_nodes.pop(r, None)
+                        self._on_agent_death(nm)
+
+                    drain(r, lambda m, n=nm: self._handle_agent_message(n, m),
+                          agent_eof)
                     continue
-                self._handle_worker_message(handle, msg)
+                unregister(r)
             self._pump()
 
     def _handle_worker_message(self, handle: WorkerHandle, msg: dict) -> None:
         mtype = msg["type"]
+        if mtype == "batch":  # coalesced replies from the worker's sender
+            for m in msg["msgs"]:
+                self._handle_worker_message(handle, m)
+            return
         if mtype == "done":
             self._on_task_done(handle, msg)
         elif mtype == "actor_created":
@@ -587,21 +852,33 @@ class Runtime:
         return False
 
     def _transfer_object(self, oid: bytes, src: NodeID, dst: NodeID) -> None:
+        """Move an object between node stores: same-host pairs memcpy
+        between shm mappings; pairs involving a remote node ride the chunked
+        push/pull plane through the agent channel (ObjectManager Push/Pull,
+        object_manager.h:114)."""
+        from .remote_node import RemoteNodeManager
+
         src_cli = self._store_client_for(src)
-        view = src_cli.get(oid)
+        view = src_cli.get(oid)  # local: shm view; remote: pulled bytes
         if view is None:
             raise ObjectLostError(oid.hex(), f"vanished from {src}")
         try:
-            dst_store = self.nodes[dst].store
-            chunk = self.config.object_manager_chunk_size
-            try:
-                buf = dst_store.create(oid, view.nbytes)
-            except ValueError:
-                return  # already there
-            for off in range(0, view.nbytes, chunk):
-                end = min(off + chunk, view.nbytes)
-                buf[off:end] = view[off:end]
-            dst_store.seal(oid)
+            dst_nm = self.nodes[dst]
+            if isinstance(dst_nm, RemoteNodeManager):
+                if not dst_nm.push_object(oid, view):
+                    raise ObjectLostError(
+                        oid.hex(), f"push to {dst_nm.hostname} failed")
+            else:
+                dst_store = dst_nm.store
+                chunk = self.config.object_manager_chunk_size
+                try:
+                    buf = dst_store.create(oid, view.nbytes)
+                except ValueError:
+                    return  # already there
+                for off in range(0, view.nbytes, chunk):
+                    end = min(off + chunk, view.nbytes)
+                    buf[off:end] = view[off:end]
+                dst_store.seal(oid)
             self.gcs.add_object_location(oid, dst)
         finally:
             src_cli.release(oid)
@@ -743,11 +1020,36 @@ class Runtime:
         )
         record = ActorRecord(actor_id, spec)
         self.gcs.register_actor(record)
+        if spec.detached and spec.registered_name:
+            # durable record: a head restarted on the same GCS storage
+            # recreates this actor (fresh state, original creation spec —
+            # the GCS-FT restart semantics of gcs_actor_manager.h:214)
+            persist = dict(payload)
+            if persist.get("cls_blob") is None:
+                persist["cls_blob"] = self.cls_blobs.get(payload["cls_id"])
+            try:
+                self.gcs.storage.put("detached_actors",
+                                     spec.registered_name,
+                                     ser.dumps(persist))
+            except Exception:
+                pass  # non-picklable args: actor works, just not durable
         info = _ActorInfo(spec, record)
         with self._lock:
             self.actors[spec.actor_id] = info
         self._request_pool.submit(self._start_actor, info)
         return spec.actor_id
+
+    def _recreate_detached_actors(self) -> None:
+        """Head-restart path: re-run the creation spec of every persisted
+        detached actor found in durable GCS storage."""
+        for name, blob in self.gcs.storage.items("detached_actors"):
+            if self.gcs.get_named_actor(name) is not None:
+                continue
+            try:
+                payload = ser.loads(blob)
+                self.create_actor(payload)
+            except Exception:
+                self.gcs.storage.delete("detached_actors", name)
 
     def _start_actor(self, info: _ActorInfo) -> None:
         spec = info.spec
@@ -761,7 +1063,8 @@ class Runtime:
                 node_id = None
                 deadline = time.monotonic() + self.config.worker_lease_timeout_s
                 while node_id is None and time.monotonic() < deadline:
-                    node_id = self.scheduler.pick_node(req, spec.strategy)
+                    node_id = self.scheduler.pick_node(
+                        req, spec.strategy, queue_if_busy=False)
                     if node_id is None:
                         time.sleep(0.02)
             if node_id is None:
@@ -943,6 +1246,10 @@ class Runtime:
             return
         if no_restart:
             info.spec.max_restarts = 0
+        if info.spec.detached and info.spec.registered_name:
+            # an explicit kill retires the durable record too
+            self.gcs.storage.delete("detached_actors",
+                                    info.spec.registered_name)
         self.gcs.set_actor_state(
             info.record.actor_id, ACTOR_DEAD, "killed via kill()"
         )
@@ -971,10 +1278,14 @@ class Runtime:
             self._conn_send_locks.pop(handle.conn, None)
             inflight = dict(handle.inflight)
             handle.inflight.clear()
-        try:
-            handle.conn.close()
-        except OSError:
-            pass
+            if hasattr(handle.conn, "fileno"):
+                # real pipe: the ROUTER must unregister it from the selector
+                # before it is closed (a closed fd number can be reused)
+                self._router_removals.append(handle.conn)
+            else:
+                handle.conn.close()  # VirtualConn: never in the selector
+        if hasattr(handle.conn, "fileno"):
+            self._wakeup()
         nm = self.nodes.get(handle.node_id)
         if nm:
             nm.remove_worker(handle)
@@ -1054,7 +1365,14 @@ class Runtime:
             with self._lock:
                 nodes = list(self.nodes.values())
             for nm in nodes:
-                if nm.alive:
+                if not nm.alive:
+                    continue
+                if hasattr(nm, "channel_send"):
+                    # remote node: liveness = the agent channel accepting
+                    # writes (EOF/half-open shows up here or at the router)
+                    if nm.channel_send({"type": "ping"}):
+                        self.gcs.heartbeat(nm.node_id)
+                else:
                     self.gcs.heartbeat(nm.node_id)
             for node_id in self.gcs.check_heartbeats(timeout):
                 self.remove_node(node_id)
@@ -1532,6 +1850,11 @@ class Runtime:
             self._send_cond.notify_all()
         if self._memory_monitor is not None:
             self._memory_monitor.stop()
+        if self._node_listener is not None:
+            try:
+                self._node_listener.close()
+            except OSError:
+                pass
         try:
             self._listener.close()
         except OSError:
@@ -1556,8 +1879,20 @@ class Runtime:
                     cli.close()
                 except Exception:
                     pass
+        for proc in self._agent_procs:
+            try:
+                proc.wait(timeout=3.0)
+            except Exception:
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
         with self._lock:
             self.memory_store.clear()
+        try:
+            self.gcs.storage.close()
+        except Exception:
+            pass
         try:
             os.close(self._wakeup_r)
             os.close(self._wakeup_w)

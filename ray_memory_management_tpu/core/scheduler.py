@@ -34,18 +34,31 @@ from .scheduling_strategies import (
 
 
 class ClusterScheduler:
-    def __init__(self, gcs: GCS, config: Optional[Config] = None):
+    def __init__(self, gcs: GCS, config: Optional[Config] = None,
+                 load_fn=None):
         self.gcs = gcs
         self.config = config or Config()
         self._lock = threading.RLock()
         self._rr_counter = 0
+        # queued-task depth per node (injected by the runtime); used to
+        # balance leases when every feasible node is at capacity
+        self.load_fn = load_fn or (lambda node_id: 0)
 
     # -- policy entry ---------------------------------------------------------
-    def pick_node(self, req: Resources, strategy=None) -> Optional[NodeID]:
-        """Select a node with available resources, or None if none can host
-        the task *right now*. Raises ValueError if no alive node could EVER
-        host it (infeasible — the reference surfaces this as a pending
-        infeasible task warning)."""
+    def pick_node(self, req: Resources, strategy=None,
+                  queue_if_busy: bool = True) -> Optional[NodeID]:
+        """Select a node to lease the task to.
+
+        With ``queue_if_busy`` (the task path) a task always lands on SOME
+        feasible node: when every feasible node is at capacity it leases to
+        the least-queued one and drains from that node's dispatch queue as
+        resources free (the raylet-queue model — the owner never re-runs
+        cluster scheduling per pump, which would be quadratic in backlog
+        depth). Without it (the actor path, which allocates immediately on
+        the chosen node) a busy cluster returns None so the caller can wait
+        for real capacity. Raises ValueError if no alive node could EVER
+        host the request (infeasible — the reference surfaces this as a
+        pending infeasible task warning)."""
         with self._lock:
             nodes = self.gcs.alive_nodes()
             if isinstance(strategy, PlacementGroupSchedulingStrategy):
@@ -56,9 +69,9 @@ class ClusterScheduler:
                 target = next(
                     (n for n in nodes if n.node_id == strategy.node_id), None
                 )
-                if target and target.resources.can_fit(req):
-                    return target.node_id
                 if target and target.resources.is_feasible(req):
+                    if queue_if_busy or target.resources.can_fit(req):
+                        return target.node_id  # queue on the pinned node
                     return None  # wait for resources on the pinned node
                 if not strategy.soft:
                     raise ValueError(
@@ -73,7 +86,14 @@ class ClusterScheduler:
                 )
             fitting = [n for n in feasible if n.resources.can_fit(req)]
             if not fitting:
-                return None
+                if not queue_if_busy:
+                    return None
+                # every feasible node is at capacity: lease to the node with
+                # the shortest dispatch queue
+                return min(
+                    feasible,
+                    key=lambda n: (self.load_fn(n.node_id), n.index),
+                ).node_id
             if strategy == SPREAD:
                 self._rr_counter += 1
                 n_fit = len(fitting)

@@ -30,6 +30,7 @@ import os
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -38,13 +39,74 @@ from .object_store import StoreClient
 
 
 class _ReplySender:
+    """Reply writer with backlog coalescing (the mirror of the runtime's
+    _sender_enqueue): an idle pipe gets the reply inline — no handoff
+    latency on sync round trips — while replies produced faster than the
+    pipe drains are batched into one ``{"type": "batch"}`` frame, one
+    pickle+write for N task completions."""
+
     def __init__(self, conn):
         self._conn = conn
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q: deque = deque()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
 
     def send(self, msg: dict) -> None:
-        with self._lock:
-            self._conn.send(msg)
+        with self._cond:
+            if self._q or self._draining:
+                self._q.append(msg)
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._drain_loop, daemon=True,
+                        name="reply-sender")
+                    self._thread.start()
+                self._cond.notify()
+                return
+            self._draining = True  # reserve the idle fast path
+        ok = self._write(msg)
+        with self._cond:
+            self._draining = False
+            if self._q and ok:
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._drain_loop, daemon=True,
+                        name="reply-sender")
+                    self._thread.start()
+                self._cond.notify()
+
+    def _write(self, payload: dict) -> bool:
+        try:
+            with self._send_lock:
+                self._conn.send(payload)
+            return True
+        except (OSError, BrokenPipeError, ValueError):
+            return False
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._draining or not self._q:
+                    if not self._q:
+                        if not self._cond.wait(timeout=30.0) and not self._q:
+                            # re-check under the lock: a reply enqueued in
+                            # the timeout/notify race must not be stranded
+                            return  # idle: let the thread die
+                    else:
+                        # an inline send is in flight; short wait keeps
+                        # ordering (timeout covers a missed notify)
+                        self._cond.wait(timeout=0.05)
+                msgs = list(self._q)
+                self._q.clear()
+                self._draining = True
+            payload = msgs[0] if len(msgs) == 1 else {
+                "type": "batch", "msgs": msgs}
+            ok = self._write(payload)
+            with self._cond:
+                self._draining = False
+            if not ok:
+                return
 
 
 class WorkerRuntimeProxy:

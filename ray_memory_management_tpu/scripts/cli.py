@@ -20,6 +20,15 @@ def _ephemeral_runtime(num_nodes: int = 1):
     return rmt.init(num_nodes=num_nodes, ignore_reinit_error=True)
 
 
+def cmd_agent(args) -> int:
+    from ray_memory_management_tpu.core import node_agent
+
+    return node_agent.main([
+        "--address", args.address, "--authkey", args.authkey,
+        "--num-cpus", str(args.num_cpus), "--num-tpus", str(args.num_tpus),
+    ])
+
+
 def cmd_status(args) -> int:
     import ray_memory_management_tpu as rmt
 
@@ -160,6 +169,17 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="show cluster resources")
     s.add_argument("--num-nodes", type=int, default=1)
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser(
+        "agent",
+        help="join this host to a head as a worker node (the reference's "
+             "'ray start --address' analog; runs a node agent that tunnels "
+             "workers + objects to the head over TCP)")
+    s.add_argument("--address", required=True, help="head HOST:PORT")
+    s.add_argument("--authkey", required=True, help="hex cluster authkey")
+    s.add_argument("--num-cpus", type=int, default=4)
+    s.add_argument("--num-tpus", type=int, default=0)
+    s.set_defaults(fn=cmd_agent)
 
     s = sub.add_parser("memory", help="object store summary")
     s.set_defaults(fn=cmd_memory)

@@ -49,6 +49,51 @@ class _TrainWorkerImpl:
         init_collective_group(world_size, rank, backend, group_name)
         return True
 
+    def _rmt_pick_coordinator(self) -> str:
+        """Rank-0 hook: choose the jax.distributed coordinator address on
+        THIS worker's host (the reference's rank-0 addr/port selection for
+        torch process groups, train/torch/config.py:108-156)."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("0.0.0.0", 0))
+        port = s.getsockname()[1]
+        s.close()
+        # routable address of this host (agents may live on other machines);
+        # a UDP connect learns the outbound interface without sending
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("8.8.8.8", 53))
+            host = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            host = "127.0.0.1"
+        return f"{host}:{port}"
+
+    def _rmt_init_jax_world(self, coordinator: str, world: int,
+                            rank: int) -> int:
+        """Form one global jax world across the worker processes
+        (jax.distributed.initialize — the NCCLUniqueID-rendezvous /
+        _setup_torch_process_group analog, SURVEY §2.3). Must run before
+        this process initializes any jax backend; afterwards jax.devices()
+        is the GLOBAL device list and one jit program spans every worker."""
+        import sys
+
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                if jax_mod._src.xla_bridge._backends:  # noqa: SLF001
+                    raise RuntimeError(
+                        "jax backends already initialized in this worker; "
+                        "xla cross-worker mode requires a fresh process")
+            except AttributeError:
+                pass
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+        return jax.device_count()
+
     def run_loop(self, loop_blob: bytes, config: Optional[dict],
                  checkpoint_blob: Optional[bytes], dataset_shard) -> bool:
         """Execute the user's train_loop_per_worker to completion. Runs on
@@ -149,6 +194,23 @@ class WorkerGroup:
             backend="objstore", group_name=self.group_name,
         )
 
+    def setup_xla_world(self) -> int:
+        """Cross-worker XLA mode: every worker process joins one
+        jax.distributed world so the user loop jits over ONE global mesh —
+        gradients sync through XLA collectives (ICI/DCN), never the object
+        plane. Returns the global device count."""
+        coordinator = api.get(
+            self.actors[0]._rmt_pick_coordinator.remote(), timeout=120)
+        counts = api.get(
+            [a._rmt_init_jax_world.remote(coordinator, self.num_workers, r)
+             for r, a in enumerate(self.actors)],
+            timeout=300,
+        )
+        if len(set(counts)) != 1:
+            raise TrainingFailedError(
+                f"workers disagree on global device count: {counts}")
+        return counts[0]
+
     def shutdown(self) -> None:
         from ..core.placement_group import remove_placement_group
 
@@ -170,11 +232,13 @@ class BackendExecutor:
     def __init__(self, num_workers: int,
                  resources_per_worker: Optional[Dict[str, Any]] = None,
                  placement_strategy: str = "PACK",
-                 use_collective: bool = True):
+                 use_collective: bool = True,
+                 collective_backend: str = "objstore"):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker or {"CPU": 1}
         self.placement_strategy = placement_strategy
         self.use_collective = use_collective and num_workers > 1
+        self.collective_backend = collective_backend
         self.group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
@@ -183,7 +247,10 @@ class BackendExecutor:
             self.placement_strategy,
         )
         if self.use_collective:
-            self.group.setup_collective()
+            if self.collective_backend == "xla":
+                self.group.setup_xla_world()
+            else:
+                self.group.setup_collective()
 
     def run(
         self,

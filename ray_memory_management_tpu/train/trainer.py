@@ -40,6 +40,10 @@ class ScalingConfig:
     chips_per_worker: int = 0
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # cross-worker gradient plane: "objstore" (CPU collective group) or
+    # "xla" (jax.distributed world — one global mesh spanning all worker
+    # processes; gradient sync rides XLA collectives over ICI/DCN)
+    collective_backend: str = "objstore"
 
     def bundle(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -137,6 +141,7 @@ class JaxTrainer:
                 self.scaling.num_workers,
                 self.scaling.bundle(),
                 self.scaling.placement_strategy,
+                collective_backend=self.scaling.collective_backend,
             )
             try:
                 executor.start()
