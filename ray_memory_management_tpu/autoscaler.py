@@ -86,7 +86,7 @@ class StandardAutoscaler:
         with rt._lock:  # nodes dict mutates under this same lock
             pending = len(rt._pending_schedule)
             node_managers = list(rt.nodes.values())
-        backlog = sum(len(nm.queue) for nm in node_managers if nm.alive)
+        backlog = sum(nm.backlog() for nm in node_managers if nm.alive)
         return pending + backlog
 
     def _node_busy(self, node_id) -> bool:

@@ -51,6 +51,11 @@ _flag("max_workers_per_node", int, 8,
       "Upper bound on pooled workers per node.")
 _flag("worker_lease_timeout_s", float, 30.0,
       "How long a task waits for a worker lease before erroring.")
+_flag("max_tasks_in_flight_per_worker", int, 10,
+      "Pipelining depth: tasks whose resource request matches a busy "
+      "worker's held lease queue on its pipe instead of waiting for the "
+      "owner round trip (the reference's small-task pipelining knob, "
+      "max_tasks_in_flight_per_worker in the direct task transport).")
 _flag("cpu_worker_env_drop", str, "PALLAS_AXON_POOL_IPS",
       "Comma-separated env vars dropped when spawning CPU-platform workers "
       "— accelerator-bootstrap triggers (sitecustomize TPU plugin init) "

@@ -120,6 +120,8 @@ class NodeAgent:
         self._stop = threading.Event()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="agent-accept").start()
+        threading.Thread(target=self._reap_loop, daemon=True,
+                         name="agent-reaper").start()
 
     # ---------------------------------------------------------------- channel
     def _send(self, msg: dict) -> None:
@@ -172,22 +174,13 @@ class NodeAgent:
             pass
 
     def _start_worker(self, msg: dict) -> None:
+        from .node_manager import build_worker_env
+
         wid_hex = msg["wid_hex"]
-        env = dict(os.environ)
+        env = build_worker_env(wid_hex, self.node_id.hex(), self.store_name,
+                               self._socket_path, self._authkey.hex(),
+                               self.config)
         env.update(msg.get("env") or {})
-        env.update({
-            "RMT_WORKER_ID": wid_hex,
-            "RMT_NODE_ID": self.node_id.hex(),
-            "RMT_STORE_NAME": self.store_name,
-            "RMT_SOCKET": self._socket_path,
-            "RMT_AUTHKEY": self._authkey.hex(),
-            "RMT_INLINE_LIMIT": str(self.inline_limit),
-            "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
-        })
-        if env["JAX_PLATFORMS"] == "cpu":
-            for var in self.config.cpu_worker_env_drop.split(","):
-                if var:
-                    env.pop(var.strip(), None)
         proc = subprocess.Popen(
             [sys.executable, "-m",
              "ray_memory_management_tpu.core.worker_main"],
@@ -195,6 +188,27 @@ class NodeAgent:
         )
         with self._lock:
             self._worker_procs[bytes.fromhex(wid_hex)] = proc
+
+    def _reap_loop(self) -> None:
+        """Detect workers that die WITHOUT ever dialing in (import error,
+        OOM at startup): no pipe means no EOF, so without this the head
+        would count them as starting forever. Also reaps the zombies."""
+        import time as _time
+
+        while not self._stop.is_set():
+            _time.sleep(1.0)
+            with self._lock:
+                dead = [(wid, p) for wid, p in self._worker_procs.items()
+                        if p.poll() is not None]
+                for wid, _ in dead:
+                    self._worker_procs.pop(wid, None)
+                connected = set(self._workers)
+            for wid, _ in dead:
+                if wid not in connected:
+                    try:
+                        self._send({"type": "wdeath", "wid": wid})
+                    except (OSError, BrokenPipeError):
+                        return
 
     # ----------------------------------------------------------- object plane
     def _obj_push(self, msg: dict) -> None:

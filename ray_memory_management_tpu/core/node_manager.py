@@ -27,7 +27,7 @@ from typing import Callable, Dict, List, Optional, Set
 from ..config import Config
 from ..ids import NodeID, WorkerID
 from .object_store import NodeObjectStore
-from .resources import NodeResources, Resources, TPU
+from .resources import CPU, NodeResources, Resources, TPU
 from .task_spec import TaskSpec
 
 
@@ -35,6 +35,7 @@ class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "node_id", "ready", "idle",
                  "known_fns", "known_classes", "actor_id", "inflight",
                  "lease_resources", "visible_chips", "pending_msgs",
+                 "death_processed", "send_lock", "steal_pending",
                  "_alive_checked_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
@@ -44,6 +45,12 @@ class WorkerHandle:
         self.node_id = node_id
         self.ready = False
         self.idle = False
+        self.death_processed = False
+        self.steal_pending = False  # a steal request is in flight
+        # serializes task-msg build+enqueue per worker: the fn_blob
+        # carried-once decision (known_fns) must stay atomic with the
+        # enqueue order now that dispatch sends outside the node lock
+        self.send_lock = threading.Lock()
         self.known_fns: Set[bytes] = set()
         self.known_classes: Set[bytes] = set()
         self.actor_id: Optional[bytes] = None  # dedicated actor worker
@@ -69,6 +76,37 @@ class WorkerHandle:
         return self.proc.poll() is None
 
 
+def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
+                     socket_path: str, authkey_hex: str,
+                     config: Config) -> Dict[str, str]:
+    """Environment for a spawned worker process — shared by the local
+    worker pool and the remote node agent so the two can never diverge.
+
+    Workers default to CPU jax — they never see the driver's TPU (the
+    driver's JAX_PLATFORMS is deliberately NOT inherited). Set
+    RMT_WORKER_JAX_PLATFORMS=tpu on the driver to spawn TPU-capable
+    workers for tasks/actors leased chips."""
+    env = dict(os.environ)
+    env.update({
+        "RMT_WORKER_ID": worker_id_hex,
+        "RMT_NODE_ID": node_id_hex,
+        "RMT_STORE_NAME": store_name,
+        "RMT_SOCKET": socket_path,
+        "RMT_AUTHKEY": authkey_hex,
+        "RMT_INLINE_LIMIT": str(config.max_direct_call_object_size),
+        "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
+    })
+    if env["JAX_PLATFORMS"] == "cpu":
+        # CPU workers skip the TPU plugin bootstrap some images run from
+        # sitecustomize at interpreter start (it imports jax + registers a
+        # PJRT backend, ~2s); dropping the trigger env vars cuts worker
+        # spawn from ~2s to ~0.2s. TPU-platform workers keep them.
+        for var in config.cpu_worker_env_drop.split(","):
+            if var:
+                env.pop(var.strip(), None)
+    return env
+
+
 class NodeManager:
     def __init__(
         self,
@@ -89,6 +127,10 @@ class NodeManager:
         self.store_name = store_name
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.idle_workers: deque = deque()
+        # pool workers currently holding a lease; pipelining candidates
+        # (max_tasks_in_flight_per_worker, the reference's small-task
+        # pipelining knob on the direct task transport)
+        self.busy_pool: Set[WorkerHandle] = set()
         self.queue: deque = deque()  # TaskSpec leased to this node
         self.starting = 0
         self.alive = True
@@ -105,28 +147,9 @@ class NodeManager:
         exec-then-connect handshake the raylet uses with its workers
         (raylet_client.h:236 registration over the raylet socket)."""
         worker_id = WorkerID.from_random()
-        env = dict(os.environ)
-        env.update({
-            "RMT_WORKER_ID": worker_id.hex(),
-            "RMT_NODE_ID": self.node_id.hex(),
-            "RMT_STORE_NAME": self.store_name,
-            "RMT_SOCKET": self.socket_path,
-            "RMT_AUTHKEY": self.authkey_hex,
-            "RMT_INLINE_LIMIT": str(self.config.max_direct_call_object_size),
-            # Workers default to CPU jax — they never see the driver's TPU
-            # (the driver's JAX_PLATFORMS is deliberately NOT inherited).
-            # Set RMT_WORKER_JAX_PLATFORMS=tpu on the driver to spawn
-            # TPU-capable workers for tasks/actors leased chips.
-            "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
-        })
-        if env["JAX_PLATFORMS"] == "cpu":
-            # CPU workers skip the TPU plugin bootstrap some images run from
-            # sitecustomize at interpreter start (it imports jax + registers a
-            # PJRT backend, ~2s); dropping the trigger env vars cuts worker
-            # spawn from ~2s to ~0.2s. TPU-platform workers keep them.
-            for var in self.config.cpu_worker_env_drop.split(","):
-                if var:
-                    env.pop(var.strip(), None)
+        env = build_worker_env(worker_id.hex(), self.node_id.hex(),
+                               self.store_name, self.socket_path,
+                               self.authkey_hex, self.config)
         proc = subprocess.Popen(
             [sys.executable, "-m",
              "ray_memory_management_tpu.core.worker_main"],
@@ -161,6 +184,7 @@ class NodeManager:
     def remove_worker(self, handle: WorkerHandle) -> None:
         with self._lock:
             self.workers.pop(handle.worker_id, None)
+            self.busy_pool.discard(handle)
             try:
                 self.idle_workers.remove(handle)
             except ValueError:
@@ -179,61 +203,206 @@ class NodeManager:
         with self._lock:
             self.queue.append(spec)
 
+    def backlog(self) -> int:
+        """Tasks leased to this node but not yet executing: the dispatch
+        queue plus everything pipelined behind a running task on a worker
+        pipe. This — not ``len(queue)`` — is the node's pending-demand
+        signal (autoscaler scale-up, scheduler least-queued balancing);
+        pipelining would otherwise drain the queue and blind both."""
+        with self._lock:
+            return len(self.queue) + sum(
+                len(h.inflight) - 1
+                for h in self.busy_pool if len(h.inflight) > 1
+            )
+
     def try_dispatch(
         self, send: Callable[[WorkerHandle, TaskSpec], None]
     ) -> None:
         """Match queued tasks to idle workers + resources; start workers on
-        demand (DispatchScheduledTasksToWorkers, local_task_manager.cc:99)."""
+        demand (DispatchScheduledTasksToWorkers, local_task_manager.cc:99).
+
+        Two dispatch modes:
+          - lease: an idle worker takes the task and its resource request is
+            allocated from the node pool;
+          - pipeline: when no idle worker/resources are left, a task whose
+            request exactly matches a busy pool worker's held lease rides
+            that lease, queued on the worker's pipe behind its current task
+            (the reference pipelines small tasks onto held leases the same
+            way — max_tasks_in_flight_per_worker on the direct transport).
+            The worker still executes serially; pipelining only hides the
+            owner↔worker turnaround latency.
+        """
+        to_send: List[tuple] = []
         with self._lock:
             if not self.alive:
                 return
-            made_progress = True
-            while made_progress and self.queue:
-                made_progress = False
+            while self.queue:
                 spec = self.queue[0]
                 # PG tasks draw from their bundle's reservation, which the
                 # scheduler already deducted from this node's pool
                 req = Resources(
                     {} if spec.placement is not None else spec.resources
                 )
-                if not req.fits_in(self.resources.available):
-                    break  # head-of-line: wait for running tasks to finish
                 handle = None
-                while self.idle_workers:
-                    cand = self.idle_workers.popleft()
-                    if cand.alive() and cand.ready:
-                        handle = cand
-                        break
+                lease = False
+                if req.fits_in(self.resources.available):
+                    while self.idle_workers:
+                        cand = self.idle_workers.popleft()
+                        if cand.alive() and cand.ready:
+                            handle = cand
+                            lease = True
+                            break
+                    if handle is None:
+                        self._start_workers_for_backlog(req)
                 if handle is None:
-                    can_start = (
-                        len(self.workers) < self.config.max_workers_per_node
-                    )
-                    if can_start and self.starting == 0:
-                        self.start_worker()
-                    break
+                    handle = self._pick_pipeline_worker(spec, req)
+                    if handle is None:
+                        break  # head-of-line: wait for a lease to free
                 self.queue.popleft()
                 handle.idle = False
                 handle.inflight[spec.task_id] = spec
-                self.resources.allocate(req)
-                handle.lease_resources = req
-                n_chips = int(req.get(TPU))
-                if n_chips > 0:
-                    handle.visible_chips = [
-                        self.free_chips.pop() for _ in range(n_chips)
-                    ]
-                made_progress = True
-                send(handle, spec)
+                if lease:
+                    self.resources.allocate(req)
+                    handle.lease_resources = req
+                    n_chips = int(req.get(TPU))
+                    if n_chips > 0:
+                        handle.visible_chips = [
+                            self.free_chips.pop() for _ in range(n_chips)
+                        ]
+                    if handle.actor_id is None:
+                        self.busy_pool.add(handle)
+                to_send.append((handle, spec))
+        # sends happen outside the node lock: a slow pipe write must not
+        # block completions (finish_task) or other dispatchers
+        for handle, spec in to_send:
+            send(handle, spec)
+
+    def pick_steal_victim(self) -> Optional[WorkerHandle]:
+        """When a worker sits idle with an empty queue while another's pipe
+        carries pipelined backlog, steal it back (the reference's direct-
+        transport work stealing): the victim returns its not-yet-started
+        tasks and the owner re-dispatches them to the idle capacity.
+        Returns the most-backlogged eligible worker, marking it
+        steal_pending (cleared when its 'stolen' reply lands)."""
+        with self._lock:
+            if self.queue or not any(
+                    h.idle and h.ready for h in self.idle_workers):
+                return None
+            best = None
+            for cand in self.busy_pool:
+                # the lease-fits check keeps stealing productive: a stolen
+                # task can only land on the idle worker if a lease of the
+                # same shape is available — otherwise it would just
+                # re-pipeline onto a busy worker (steal/re-pipeline churn)
+                if (len(cand.inflight) > 1 and not cand.steal_pending
+                        and cand.alive()
+                        and cand.lease_resources is not None
+                        and cand.lease_resources.fits_in(
+                            self.resources.available)):
+                    if best is None or len(cand.inflight) > \
+                            len(best.inflight):
+                        best = cand
+            if best is not None:
+                best.steal_pending = True
+            return best
+
+    def return_stolen(self, handle: WorkerHandle, task_ids) -> list:
+        """Take stolen tasks back from ``handle``: re-queue their specs at
+        the FRONT (they were dispatched first) and release the worker's
+        lease if its pipeline drained. Returns the requeued specs."""
+        specs = []
+        with self._lock:
+            handle.steal_pending = False
+            for tid in task_ids:
+                spec = handle.inflight.pop(tid, None)
+                if spec is not None:
+                    specs.append(spec)
+                    # the blob-carrying dispatch may itself be stolen, so
+                    # this worker can no longer be assumed to know the fn
+                    handle.known_fns.discard(spec.fn_id)
+            for spec in reversed(specs):
+                self.queue.appendleft(spec)
+            if not handle.inflight and handle.lease_resources is not None:
+                self.resources.free(handle.lease_resources)
+                handle.lease_resources = None
+                if handle.visible_chips:
+                    self.free_chips.extend(handle.visible_chips)
+                    handle.visible_chips = None
+                self.busy_pool.discard(handle)
+                if handle.actor_id is None and handle.alive():
+                    handle.idle = True
+                    self.idle_workers.appendleft(handle)
+        return specs
+
+    def _start_workers_for_backlog(self, req: Resources) -> None:
+        """Start enough workers to cover the queued backlog, bounded by the
+        resource slots the node could actually lease (the reference
+        prestarts workers per dispatch round the same way,
+        worker_pool.h:349 PrestartWorkers)."""
+        can_start = self.config.max_workers_per_node - len(self.workers)
+        if can_start <= self.starting:
+            return
+        # how many copies of `req` fit in what's still available (pure
+        # arithmetic: this runs on every dispatch round with an empty idle
+        # pool, so no trial-allocation loop)
+        slots = 64
+        avail = self.resources.available
+        for name, amount in req.to_dict().items():
+            if amount > 0:
+                slots = min(slots, int(avail.get(name) / amount))
+        want = min(len(self.queue), slots, can_start) - self.starting
+        for _ in range(max(0, want)):
+            self.start_worker()
+
+    def _pick_pipeline_worker(
+        self, spec: TaskSpec, req: Resources
+    ) -> Optional[WorkerHandle]:
+        """A busy pool worker whose held lease matches ``req`` exactly and
+        whose pipe backlog is under the pipelining depth.
+
+        runtime_env tasks never pipeline (in either direction): applying an
+        env mutates process-wide state (os.environ, cwd, sys.path), which is
+        only safe while the worker executes strictly serially — and a
+        blocked task can grow a second executor thread (_TaskDispatcher)."""
+        depth = self.config.max_tasks_in_flight_per_worker
+        # only small tasks pipeline (the reference's pipelining likewise
+        # targets the high-rate small-task path): a request over 1 CPU
+        # signals heavy work, where serializing behind a busy worker loses
+        # more than the owner round trip costs — those wait for a lease
+        # (or for the autoscaler, which sees them via backlog())
+        if (depth <= 1 or spec.placement is not None or req.get(TPU) > 0
+                or req.get(CPU) > 1.0 or spec.runtime_env):
+            return None
+        best = None
+        best_depth = depth
+        for cand in self.busy_pool:
+            # steal_pending workers are off-limits: a dispatch racing the
+            # in-flight steal could omit a fn_blob the steal is about to
+            # take back (known_fns is only reconciled at the stolen reply)
+            if (len(cand.inflight) < best_depth
+                    and cand.lease_resources == req
+                    and cand.ready and cand.alive()
+                    and not cand.steal_pending
+                    and not any(s.runtime_env
+                                for s in cand.inflight.values())):
+                best = cand
+                best_depth = len(cand.inflight)
+        return best
 
     def finish_task(self, handle: WorkerHandle, task_id: bytes) -> None:
-        """Free the lease and return the worker to the pool."""
+        """Release the task; free the lease and return the worker to the
+        pool once its pipeline drains."""
         with self._lock:
             handle.inflight.pop(task_id, None)
+            if handle.inflight:
+                return  # pipelined tasks still riding this lease
             if handle.lease_resources is not None:
                 self.resources.free(handle.lease_resources)
                 handle.lease_resources = None
             if handle.visible_chips:
                 self.free_chips.extend(handle.visible_chips)
                 handle.visible_chips = None
+            self.busy_pool.discard(handle)
             if handle.actor_id is None and handle.alive():
                 handle.idle = True
                 # LIFO: reuse the hottest worker — on small tasks this keeps
@@ -249,6 +418,7 @@ class NodeManager:
         with self._lock:
             handle.actor_id = actor_id
             handle.idle = False
+            self.busy_pool.discard(handle)
             try:
                 self.idle_workers.remove(handle)
             except ValueError:

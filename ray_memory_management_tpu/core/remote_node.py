@@ -120,6 +120,7 @@ class RemoteNodeManager(NodeManager):
         from collections import deque
 
         self.idle_workers = deque()
+        self.busy_pool = set()
         self.queue = deque()
         self.starting = 0
         self.alive = True
@@ -266,14 +267,17 @@ class RemoteNodeManager(NodeManager):
         with self._lock:
             return self.workers.get(WorkerID(wid))
 
-    def mark_dead(self) -> None:
-        self.alive = False
-        # wake every transfer waiting on this channel
+    def _abort_pending(self, reason: str) -> None:
+        """Wake every transfer blocked on this channel with an error."""
         with self._pending_lock:
             for state in self._pending.values():
-                state["error"] = "node died"
+                state["error"] = reason
                 state["event"].set()
             self._pending.clear()
+
+    def mark_dead(self) -> None:
+        self.alive = False
+        self._abort_pending("node died")
         for h in self.workers.values():
             if isinstance(h.proc, RemoteProc):
                 h.proc.returncode = 1
@@ -281,6 +285,10 @@ class RemoteNodeManager(NodeManager):
     def shutdown(self, unlink_store: bool = True) -> None:
         self.channel_send({"type": "shutdown"})
         self.alive = False
+        # in-flight pulls/pushes will never get replies once the channel
+        # closes; waking them here keeps driver shutdown from parking a
+        # transfer thread for its full timeout
+        self._abort_pending("node shut down")
         try:
             self.channel.close()
         except Exception:

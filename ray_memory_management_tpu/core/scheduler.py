@@ -61,6 +61,17 @@ class ClusterScheduler:
         pending infeasible task warning)."""
         with self._lock:
             nodes = self.gcs.alive_nodes()
+            # single-node fast path: with one alive node and no strategy the
+            # full policy walk always lands there — skip it (this sits on
+            # the per-task submit path)
+            if strategy is None and queue_if_busy and len(nodes) == 1:
+                node = nodes[0]
+                if node.resources.is_feasible(req):
+                    return node.node_id
+                raise ValueError(
+                    f"infeasible resource request {req.to_dict()}: no alive "
+                    f"node can ever satisfy it"
+                )
             if isinstance(strategy, PlacementGroupSchedulingStrategy):
                 raise RuntimeError(
                     "PG strategies are resolved by PlacementGroupManager"

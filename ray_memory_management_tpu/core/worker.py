@@ -39,42 +39,30 @@ from .object_store import StoreClient
 
 
 class _ReplySender:
-    """Reply writer with backlog coalescing (the mirror of the runtime's
-    _sender_enqueue): an idle pipe gets the reply inline — no handoff
-    latency on sync round trips — while replies produced faster than the
-    pipe drains are batched into one ``{"type": "batch"}`` frame, one
-    pickle+write for N task completions."""
+    """Reply writer owned by one persistent drain thread (the mirror of the
+    runtime's _sender_enqueue): every enqueued reply is coalesced with
+    whatever else accumulated into one ``{"type": "batch"}`` frame — one
+    pickle + ONE pipe write for N completions. Each write to the driver
+    pipe wakes the driver process (two context switches on a loaded host),
+    so the executor thread never writes inline; it keeps executing while
+    this thread drains."""
 
     def __init__(self, conn):
         self._conn = conn
         self._send_lock = threading.Lock()
         self._cond = threading.Condition()
         self._q: deque = deque()
-        self._draining = False
         self._thread: Optional[threading.Thread] = None
 
     def send(self, msg: dict) -> None:
         with self._cond:
-            if self._q or self._draining:
-                self._q.append(msg)
-                if self._thread is None or not self._thread.is_alive():
-                    self._thread = threading.Thread(
-                        target=self._drain_loop, daemon=True,
-                        name="reply-sender")
-                    self._thread.start()
-                self._cond.notify()
-                return
-            self._draining = True  # reserve the idle fast path
-        ok = self._write(msg)
-        with self._cond:
-            self._draining = False
-            if self._q and ok:
-                if self._thread is None or not self._thread.is_alive():
-                    self._thread = threading.Thread(
-                        target=self._drain_loop, daemon=True,
-                        name="reply-sender")
-                    self._thread.start()
-                self._cond.notify()
+            self._q.append(msg)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name="reply-sender")
+                self._thread.start()
+            self._cond.notify()
 
     def _write(self, payload: dict) -> bool:
         try:
@@ -87,26 +75,115 @@ class _ReplySender:
     def _drain_loop(self) -> None:
         while True:
             with self._cond:
-                while self._draining or not self._q:
-                    if not self._q:
-                        if not self._cond.wait(timeout=30.0) and not self._q:
-                            # re-check under the lock: a reply enqueued in
-                            # the timeout/notify race must not be stranded
-                            return  # idle: let the thread die
-                    else:
-                        # an inline send is in flight; short wait keeps
-                        # ordering (timeout covers a missed notify)
-                        self._cond.wait(timeout=0.05)
+                while not self._q:
+                    self._cond.wait()
                 msgs = list(self._q)
                 self._q.clear()
-                self._draining = True
             payload = msgs[0] if len(msgs) == 1 else {
                 "type": "batch", "msgs": msgs}
-            ok = self._write(payload)
-            with self._cond:
-                self._draining = False
-            if not ok:
+            if not self._write(payload):
                 return
+
+
+class _TaskDispatcher:
+    """Serial plain-task executor that grows one thread whenever the
+    running task parks in an owner round trip (nested get/wait).
+
+    Pipelined dispatch queues several tasks on this worker's pipe; if the
+    executing task blocks on a dependency produced by a task queued BEHIND
+    it, a fixed single thread would deadlock. The reference's semantics are
+    that a worker blocked in ray.get releases its slot and other work
+    proceeds; here that means: keep exactly one runnable executor thread,
+    spawning a new one when the current one blocks (bounded by the
+    pipelining depth, since only queued tasks trigger growth)."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._threads = 0   # live executor threads
+        self._blocked = 0   # parked in an owner wait (proxy request)
+        self._waiting = 0   # idle, parked on the queue
+        self._resuming = 0  # returned from an owner wait, parked for turn
+        self._is_exec = threading.local()
+
+    def _runnable(self) -> int:
+        return self._threads - self._blocked - self._waiting - self._resuming
+
+    def submit(self, fn, msg) -> None:
+        with self._cond:
+            self._q.append((fn, msg))
+            if self._waiting:
+                self._cond.notify_all()
+            elif self._runnable() < 1:
+                self._spawn()
+
+    def _spawn(self) -> None:
+        self._threads += 1
+        threading.Thread(target=self._loop, daemon=True,
+                         name="task-exec").start()
+
+    def _loop(self) -> None:
+        self._is_exec.flag = True
+        while True:
+            with self._cond:
+                self._waiting += 1
+                self._cond.notify_all()  # runnable dropped: a resumer may go
+                while True:
+                    # claim work only while holding the sole runnable slot
+                    if self._q and self._runnable() == 0:
+                        break
+                    if not self._q and self._waiting > 1:
+                        # one parked thread is enough; surplus threads
+                        # (grown while a task blocked) retire here
+                        self._waiting -= 1
+                        self._threads -= 1
+                        return
+                    self._cond.wait()
+                self._waiting -= 1
+                fn, msg = self._q.popleft()
+            fn(msg)
+
+    def steal(self) -> list:
+        """Remove and return every not-yet-started plain-task message
+        (work stealing: the owner re-dispatches these to an idle worker —
+        the reference's direct-transport steal protocol). Tasks already
+        executing are untouched; only queued ``exec`` frames move."""
+        with self._cond:
+            kept, stolen = deque(), []
+            while self._q:
+                fn, msg = self._q.popleft()
+                if isinstance(msg, dict) and msg.get("type") == "exec":
+                    stolen.append(msg)
+                else:
+                    kept.append((fn, msg))
+            self._q = kept
+        return stolen
+
+    def enter_blocked(self) -> None:
+        """The calling executor thread is about to park in an owner wait."""
+        if not getattr(self._is_exec, "flag", False):
+            return
+        with self._cond:
+            self._blocked += 1
+            self._cond.notify_all()  # runnable dropped: queue may proceed
+            if self._q and self._runnable() < 1 and not self._waiting:
+                self._spawn()
+
+    def exit_blocked(self) -> None:
+        """Owner wait finished. Tasks execute strictly serially in a worker
+        (process-wide state: cwd, env, native libs); if another executor
+        thread took the runnable slot while we were blocked, park here
+        until it blocks, finishes, or retires."""
+        if not getattr(self._is_exec, "flag", False):
+            return
+        with self._cond:
+            self._blocked -= 1
+            # after the decrement this thread itself counts as runnable;
+            # park only while some OTHER thread holds the slot too
+            while self._runnable() > 1:
+                self._resuming += 1
+                self._cond.wait()
+                self._resuming -= 1
 
 
 class WorkerRuntimeProxy:
@@ -135,7 +212,15 @@ class WorkerRuntimeProxy:
             self._events[req_id] = ev
         msg["req_id"] = req_id
         self._worker.sender.send(msg)
-        if not ev.wait(timeout if timeout is not None else 3600.0):
+        # an owner round trip can block on dependencies this worker itself
+        # has queued — let the pipeline keep draining while we park
+        dispatcher = self._worker.task_dispatcher
+        dispatcher.enter_blocked()
+        try:
+            ok = ev.wait(timeout if timeout is not None else 3600.0)
+        finally:
+            dispatcher.exit_blocked()
+        if not ok:
             raise TimeoutError(f"worker request {msg['type']} timed out")
         with self._lock:
             reply = self._pending.pop(req_id)
@@ -328,9 +413,7 @@ class Worker:
         self.functions: Dict[bytes, Any] = {}
         self.classes: Dict[bytes, Any] = {}
         self.actors: Dict[bytes, _ActorState] = {}
-        self.task_executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task"
-        )
+        self.task_dispatcher = _TaskDispatcher()
         self._shutdown = threading.Event()
 
     # -- value encoding -------------------------------------------------------
@@ -407,9 +490,13 @@ class Worker:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
-            from ..runtime_env import applied as _env_applied
+            env = msg.get("runtime_env")
+            if env:
+                from ..runtime_env import applied as _env_applied
 
-            with _env_applied(msg.get("runtime_env")):
+                with _env_applied(env):
+                    result = fn(*args, **kwargs)
+            else:
                 result = fn(*args, **kwargs)
             returns = self._split_returns(result, msg["return_ids"])
             reply = {
@@ -618,13 +705,15 @@ class Worker:
     def _dispatch(self, msg: dict) -> None:
         mtype = msg["type"]
         if mtype == "exec":
-            self.task_executor.submit(self.exec_task, msg)
+            self.task_dispatcher.submit(self.exec_task, msg)
         elif mtype == "exec_actor":
             state = self.actors.get(msg["actor_id"])
-            executor = state.executor if state else self.task_executor
-            executor.submit(self.exec_actor_task, msg)
+            if state is not None:
+                state.executor.submit(self.exec_actor_task, msg)
+            else:
+                self.task_dispatcher.submit(self.exec_actor_task, msg)
         elif mtype == "create_actor":
-            self.task_executor.submit(self.create_actor, msg)
+            self.task_dispatcher.submit(self.create_actor, msg)
         elif mtype == "reply":
             self.proxy.deliver(msg)
         elif mtype == "materialize_device":
@@ -633,6 +722,12 @@ class Worker:
             threading.Thread(
                 target=self.materialize_device, args=(msg,),
                 daemon=True, name="materialize-device").start()
+        elif mtype == "steal":
+            stolen = self.task_dispatcher.steal()
+            self.sender.send({
+                "type": "stolen",
+                "task_ids": [m["task_id"] for m in stolen],
+            })
         elif mtype == "free_device":
             self.device_store.delete(msg["object_id"])
         elif mtype == "ping":

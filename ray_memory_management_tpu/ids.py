@@ -18,6 +18,39 @@ import threading
 
 _ID_SIZE = 16  # 128-bit, as in the reference (id_specification.md)
 
+
+class _EntropyPool:
+    """Buffered os.urandom: one syscall refills 4 KiB instead of one
+    syscall per ID (ID minting sits on the task-submission hot path)."""
+
+    __slots__ = ("_buf", "_pos", "_lock")
+
+    def __init__(self):
+        self._buf = b""
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> bytes:
+        with self._lock:
+            if self._pos + n > len(self._buf):
+                self._buf = os.urandom(4096)
+                self._pos = 0
+            out = self._buf[self._pos : self._pos + n]
+            self._pos += n
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf = b""
+            self._pos = 0
+
+
+_entropy = _EntropyPool()
+# A forked child inheriting the buffer would mint the parent's exact IDs;
+# os.urandom had no such hazard, so restore it at fork time.
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_entropy.reset)
+
 # Number of trailing bytes of an ObjectID that encode the return index. The
 # reference packs the index into the ObjectID the same way
 # (src/ray/common/id.h ObjectID::FromIndex).
@@ -39,7 +72,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        return cls(_entropy.take(_ID_SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -95,9 +128,6 @@ class PlacementGroupID(BaseID):
 
 
 class TaskID(BaseID):
-    _counter = 0
-    _lock = threading.Lock()
-
     @classmethod
     def for_task(cls, job_id: JobID) -> "TaskID":
         """Fresh task id carrying the job in its first 4 bytes.
@@ -106,11 +136,9 @@ class TaskID(BaseID):
         embed a return index there and still map back to this task via
         :meth:`ObjectID.task_id`.
         """
-        with cls._lock:
-            cls._counter += 1
         return cls(
             job_id.binary()[:4]
-            + os.urandom(_ID_SIZE - 4 - _INDEX_BYTES)
+            + _entropy.take(_ID_SIZE - 4 - _INDEX_BYTES)
             + b"\x00" * _INDEX_BYTES
         )
 
@@ -129,7 +157,7 @@ class ObjectID(BaseID):
     @classmethod
     def for_put(cls) -> "ObjectID":
         """Random ID for a driver/worker ``put`` (no lineage)."""
-        return cls(os.urandom(_ID_SIZE))
+        return cls(_entropy.take(_ID_SIZE))
 
     def task_id(self) -> TaskID:
         """The producing task's ID prefix (valid only for return objects)."""
