@@ -139,29 +139,37 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh, sp_axis):
     return flash_attention(q, k, v, causal=True, use_pallas=use)
 
 
+def apply_block(x, layer, cfg: TransformerConfig, mesh=None, sp_axis=None):
+    """One transformer block: x [B, S, D] + per-layer weight dict -> [B, S, D].
+    Shapes derive from ``x`` so the same block serves the full forward and
+    the pipeline-parallel schedule (parallel/pipeline.py), where the batch
+    dimension is a microbatch slice."""
+    B, S = x.shape[0], x.shape[1]
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    positions = jnp.arange(S)[None, :]
+    h = _rmsnorm(x, layer["ln1"])
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, Dh)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
+    q = _rope(q, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+    k = _rope(k, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    o = _attention(q, k, v, cfg, mesh, sp_axis)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+    x = x + o @ layer["wo"].astype(cfg.dtype)
+    h = _rmsnorm(x, layer["ln2"])
+    gate = jax.nn.silu(h @ layer["w1"].astype(cfg.dtype))
+    up = h @ layer["w3"].astype(cfg.dtype)
+    x = x + (gate * up) @ layer["w2"].astype(cfg.dtype)
+    return x
+
+
 def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
     """tokens [B, S] -> logits [B, S, V] (fp32)."""
-    B, S = tokens.shape
     x = params["tok_embed"][tokens].astype(cfg.dtype)
-    positions = jnp.arange(S)[None, :]
-    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
 
     def block(x, layer):
-        h = _rmsnorm(x, layer["ln1"])
-        q = (h @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, Dh)
-        k = (h @ layer["wk"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
-        v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
-        q = _rope(q, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
-        k = _rope(k, positions, cfg.rope_theta).transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
-        o = _attention(q, k, v, cfg, mesh, sp_axis)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
-        x = x + o @ layer["wo"].astype(cfg.dtype)
-        h = _rmsnorm(x, layer["ln2"])
-        gate = jax.nn.silu(h @ layer["w1"].astype(cfg.dtype))
-        up = h @ layer["w3"].astype(cfg.dtype)
-        x = x + (gate * up) @ layer["w2"].astype(cfg.dtype)
-        return x
+        return apply_block(x, layer, cfg, mesh, sp_axis)
 
     block_fn = jax.checkpoint(block) if cfg.remat else block
 

@@ -1,6 +1,12 @@
-"""Parallelism strategy library: meshes + sharding presets (DP/FSDP/TP/SP)."""
+"""Parallelism strategy library: meshes + sharding presets (DP/FSDP/TP/SP/PP)."""
 
 from .mesh import cpu_mesh, local_tpu_mesh, make_mesh  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_blocks,
+    pipeline_forward,
+    pipeline_loss_fn,
+    stacked_param_pspecs,
+)
 from .sharding import (  # noqa: F401
     batch_pspec,
     make_train_step,

@@ -1,0 +1,184 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over mesh stages.
+
+Net-new versus the reference, which has no pipeline-parallel library — it only
+offers the building blocks (actors + ``collective.send/recv``,
+util/collective/collective.py:531,594, and static task graphs via ray.dag,
+python/ray/dag/dag_node.py:23). SURVEY.md §2.4 maps PP as composable-but-
+absent; VERDICT r1 item 8 asks for the real thing. Here it is TPU-idiomatic:
+
+  - one SPMD program over a mesh with a ``pp`` axis (no actor choreography,
+    no point-to-point sends): every device runs the same ``shard_map``-ped
+    schedule, holding its stage's slice of the LAYER-STACKED parameters
+    (models/gpt.py keeps weights as [L, ...] pytrees, so "stage s owns
+    layers [s*L/S, (s+1)*L/S)" is just a sharding of the leading dim);
+  - activations flow between stages with ``lax.ppermute`` — XLA lowers it
+    to a collective-permute that rides neighbor ICI links, exactly the
+    transfer pattern the TPU torus is built for;
+  - the schedule is the classic GPipe fill/flush loop: M microbatches over
+    S stages in M + S - 1 steps, expressed as a ``lax.scan`` (static trip
+    count, jit-compatible);
+  - the whole schedule is DIFFERENTIABLE: jax autodiff through
+    scan+ppermute yields the reverse schedule (transpose of a ppermute is
+    the reverse ppermute), so ``jax.grad`` of a pipelined loss just works,
+    with weight grads landing sharded over ``pp`` like the weights.
+
+Composes with data parallelism by adding a ``dp`` axis to the mesh: batch
+shards over dp, each dp-row runs its own pipeline, and XLA inserts the grad
+psum across dp (see test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stage_pspec(n_dims: int, axis: str = "pp") -> P:
+    """Spec sharding a layer-stacked parameter's leading dim over stages."""
+    return P(axis, *([None] * (n_dims - 1)))
+
+
+def stacked_param_pspecs(params: Any, axis: str = "pp") -> Any:
+    """PartitionSpec pytree placing every layer-stacked leaf on its stage."""
+    return jax.tree.map(lambda p: stage_pspec(p.ndim, axis), params)
+
+
+def pipeline_blocks(
+    block_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int = 0,
+    batch_axes: tuple = (),
+):
+    """Run ``x`` through L stacked layers pipelined over the ``axis`` stages.
+
+    block_fn(x_mb, layer) applies ONE layer (a pytree slice of
+    ``stacked_params`` at leading index l) to a microbatch activation.
+    stacked_params: pytree with leading dim L (L % n_stages == 0), sharded
+    over ``axis``. x: [B, ...] activations (replicated over ``axis``;
+    optionally sharded over ``batch_axes`` — e.g. ("dp",) — in which case B
+    here is the per-shard batch). Returns [B, ...] like a plain layer scan.
+
+    Schedule: step t of M+S-1 —
+      stage 0 consumes microbatch min(t, M-1); stage s consumes what stage
+      s-1 produced at t-1 (delivered by ppermute); stage S-1's outputs for
+      t >= S-1 are microbatch t-(S-1)'s result. Bubble fraction is the GPipe
+      (S-1)/(M+S-1).
+    """
+    S = mesh.shape[axis]
+    if n_microbatches <= 0:
+        n_microbatches = S
+    M = n_microbatches
+    B = x.shape[0]
+    # the schedule slices the PER-SHARD batch into microbatches: validate
+    # against the shard size, not the global batch
+    shards = 1
+    for a in batch_axes:
+        shards *= mesh.shape[a]
+    if B % shards != 0:
+        raise ValueError(
+            f"batch {B} not divisible over batch_axes {batch_axes} "
+            f"({shards} shards)")
+    if (B // shards) % M != 0:
+        raise ValueError(
+            f"per-shard batch {B // shards} (batch {B} over {shards} "
+            f"{batch_axes} shards) not divisible by {M} microbatches")
+
+    bspec = P(batch_axes if batch_axes else None)
+    param_specs = stacked_param_pspecs(stacked_params, axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(param_specs, bspec),
+        out_specs=bspec,
+        check_vma=False,
+    )
+    def run(params_local, x_local):
+        stage = lax.axis_index(axis)
+        b = x_local.shape[0]
+        mbs = x_local.reshape(M, b // M, *x_local.shape[1:])
+
+        def stage_apply(h):
+            def body(h, layer):
+                return block_fn(h, layer), None
+
+            h, _ = lax.scan(body, h, params_local)
+            return h
+
+        def step(carry, t):
+            state, outputs = carry
+            # stage 0 injects microbatch t (clamped during the flush tail)
+            x_t = lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            h_in = jnp.where(stage == 0, x_t, state)
+            y = stage_apply(h_in)
+            # the last stage emits microbatch t-(S-1) during the drain
+            out_t = t - (S - 1)
+            valid = (out_t >= 0) & (stage == S - 1)
+            safe_t = jnp.clip(out_t, 0, M - 1)
+            prev = lax.dynamic_index_in_dim(outputs, safe_t, 0,
+                                            keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, prev), safe_t, 0)
+            # hand this stage's activation to the next stage over ICI
+            state = lax.ppermute(
+                y, axis, [(i, (i + 1) % S) for i in range(S)])
+            return (state, outputs), None
+
+        state0 = jnp.zeros_like(mbs[0])
+        outputs0 = jnp.zeros_like(mbs)
+        (_, outputs), _ = lax.scan(
+            step, (state0, outputs0), jnp.arange(M + S - 1))
+        # results live on the last stage only; psum broadcasts them so the
+        # caller sees a pp-replicated activation (zeros elsewhere)
+        outputs = jnp.where(stage == S - 1, outputs, 0)
+        outputs = lax.psum(outputs, axis)
+        return outputs.reshape(b, *x_local.shape[1:])
+
+    return run(stacked_params, x)
+
+
+# ---------------------------------------------------------------- LM wiring
+def pipeline_forward(params, tokens, cfg, mesh: Mesh, axis: str = "pp",
+                     n_microbatches: int = 0, batch_axes: tuple = ()):
+    """TransformerLM forward with the block stack pipelined over ``axis``.
+
+    Embedding and head are small next to the block stack; they run
+    replicated over pp (sharded over ``batch_axes`` if given), while the
+    [L, ...] layer stack streams microbatches through the stages.
+    """
+    from ..models import gpt
+
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+
+    def block(h, layer):
+        return gpt.apply_block(h, layer, cfg)
+
+    x = pipeline_blocks(block, params["layers"], x, mesh, axis=axis,
+                        n_microbatches=n_microbatches,
+                        batch_axes=batch_axes)
+    x = gpt._rmsnorm(x, params["final_ln"])
+    logits = lax.dot_general(
+        x, params["lm_head"].astype(cfg.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def pipeline_loss_fn(params, batch, cfg, mesh: Mesh, axis: str = "pp",
+                     n_microbatches: int = 0, batch_axes: tuple = ()):
+    """Drop-in for models.gpt.loss_fn with a pipelined block stack."""
+    logits = pipeline_forward(params, batch["tokens"], cfg, mesh, axis,
+                              n_microbatches, batch_axes)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    take = jnp.take_along_axis(logits, batch["targets"][..., None],
+                               axis=-1)[..., 0]
+    return jnp.mean(lse - take)
