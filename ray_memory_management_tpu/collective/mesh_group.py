@@ -128,14 +128,21 @@ class MeshCollectives:
                             lambda: self._build_broadcast(root))
 
     def _build_broadcast(self, root: int):
+        # masked psum: every rank contributes zeros except the root, so the
+        # reduction IS the root's slice. Moves O(bytes) per ICI link (the
+        # ring allreduce schedule), not the O(world * bytes) of gathering
+        # the whole stack to every rank. (jax's ppermute cannot express a
+        # one-to-all fanout — sources must be unique — and a log-round tree
+        # would be latency-optimal but more program for no bandwidth win.)
         def body(t):
-            # every rank takes root's slice: a collective-permute from root
-            full = lax.all_gather(t[0], _AXIS, axis=0)
-            return full[root][None]
+            rank = lax.axis_index(_AXIS)
+            contrib = jnp.where(rank == root, t, jnp.zeros_like(t))
+            return lax.psum(contrib, _AXIS)
 
         return jax.jit(self._smap(body))
 
     def broadcast(self, stacked, root: int = 0):
+        """Every rank-slice of the result equals root's input slice."""
         return self._broadcast_fn(root)(self.shard_ranks(stacked))
 
     def _ppermute_fn(self, perm: tuple):
@@ -159,10 +166,30 @@ class MeshCollectives:
         collective.py:531,594 — NCCL P2P maps to ppermute on ICI)."""
         return self.ppermute(stacked, [(src, dst)])
 
+    def _reduce_rooted_fn(self, root: int, op: str):
+        def build():
+            red = _reduce_fn(op)
+
+            def body(t):
+                out = red(t)
+                rank = lax.axis_index(_AXIS)
+                # NCCL reduce semantics: only root's output is defined;
+                # other ranks keep their input slice (cheap, and closer to
+                # "unmodified buffer" than fabricated zeros)
+                return jnp.where(rank == root, out, t)
+
+            return jax.jit(self._smap(body))
+
+        return self._cached(("reduce", root, op), build)
+
     def reduce(self, stacked, root_rank: int = 0, op: str = ReduceOp.SUM):
-        # On ICI an allreduce and a rooted reduce cost the same (the ring
-        # passes every link either way); return the allreduce result.
-        return self.allreduce(stacked, op)
+        """Rooted reduce: root's slice of the result holds the reduction;
+        other slices pass through unchanged. (On ICI the wire cost matches
+        allreduce — the ring crosses every link either way — but the
+        SEMANTICS are rooted, as in the reference's collective.reduce,
+        util/collective/collective.py:311.)"""
+        return self._reduce_rooted_fn(root_rank, op)(
+            self.shard_ranks(stacked))
 
     def barrier(self):
         jax.block_until_ready(self.allreduce(

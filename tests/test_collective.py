@@ -65,6 +65,28 @@ def test_mesh_broadcast(mesh_group):
         np.testing.assert_allclose(out[r], np.full((2,), 3, np.float32))
 
 
+def test_mesh_reduce_rooted(mesh_group):
+    """reduce is ROOTED (collective.py:311 semantics): only root's slice
+    holds the reduction; other slices pass through unchanged (VERDICT r1
+    item 9 — previously this silently returned the full allreduce)."""
+    w = mesh_group.world_size
+    stacked = np.stack([np.full((4,), i, np.float32) for i in range(w)])
+    out = np.asarray(mesh_group.reduce(stacked, root_rank=2))
+    np.testing.assert_allclose(out[2], np.full((4,), sum(range(w))))
+    for r in range(w):
+        if r != 2:
+            np.testing.assert_allclose(out[r], stacked[r])
+
+
+def test_mesh_reduce_rooted_max(mesh_group):
+    w = mesh_group.world_size
+    stacked = np.stack([np.full((3,), i, np.float32) for i in range(w)])
+    out = np.asarray(mesh_group.reduce(stacked, root_rank=0,
+                                       op=col.ReduceOp.MAX))
+    np.testing.assert_allclose(out[0], np.full((3,), w - 1, np.float32))
+    np.testing.assert_allclose(out[1], stacked[1])
+
+
 def test_mesh_ppermute_ring(mesh_group):
     w = mesh_group.world_size
     stacked = np.stack([np.full((2,), i, np.float32) for i in range(w)])
