@@ -51,6 +51,10 @@ _flag("max_workers_per_node", int, 8,
       "Upper bound on pooled workers per node.")
 _flag("worker_lease_timeout_s", float, 30.0,
       "How long a task waits for a worker lease before erroring.")
+_flag("log_to_driver", bool, True,
+      "Stream worker stdout/stderr to the driver, prefixed with the worker "
+      "identity (the reference's log monitor tails worker logs to the "
+      "driver, services.py:1126; here the lines ride the worker pipe).")
 _flag("max_tasks_in_flight_per_worker", int, 10,
       "Pipelining depth: tasks whose resource request matches a busy "
       "worker's held lease queue on its pipe instead of waiting for the "
@@ -104,7 +108,6 @@ _flag("memory_usage_threshold", float, 0.95,
       "(ray_config_def.h memory_usage_threshold analog).")
 _flag("event_stats", bool, True,
       "Collect per-handler event-loop stats (src/ray/common/event_stats.cc).")
-_flag("log_to_driver", bool, True, "Forward worker logs to the driver.")
 
 
 def _coerce(typ, raw: str):

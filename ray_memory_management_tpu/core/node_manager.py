@@ -94,6 +94,7 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
         "RMT_SOCKET": socket_path,
         "RMT_AUTHKEY": authkey_hex,
         "RMT_INLINE_LIMIT": str(config.max_direct_call_object_size),
+        "RMT_LOG_TO_DRIVER": "1" if config.log_to_driver else "0",
         "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
     })
     if env["JAX_PLATFORMS"] == "cpu":

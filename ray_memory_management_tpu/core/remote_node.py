@@ -134,6 +134,7 @@ class RemoteNodeManager(NodeManager):
         self.channel = channel
         self.gcs = gcs
         self.hostname = hostname
+        self.agent_pid: Optional[int] = None  # pid on the agent's host
         self._channel_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, dict] = {}       # req -> accumulating state

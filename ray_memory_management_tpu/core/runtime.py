@@ -112,6 +112,7 @@ class Runtime:
         # device-object ownership: oid -> "driver" | WorkerHandle
         self._device_locations: Dict[bytes, Any] = {}
         self._materialize_futs: Dict[bytes, Future] = {}
+        self._log_tails: Dict[Any, bytes] = {}  # worker id -> partial line
         self.futures: Dict[bytes, Future] = {}
         self.tasks: Dict[bytes, _TaskRecord] = {}
         self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
@@ -363,6 +364,9 @@ class Runtime:
                 channel=conn, gcs=self.gcs,
                 hostname=msg.get("hostname", "?"),
             )
+            # pid on the agent's host — fault-injection tooling (NodeKiller
+            # sigkill mode) and diagnostics key off it
+            nm.agent_pid = msg.get("pid")
             try:
                 conn.send({
                     "type": "registered",
@@ -673,6 +677,8 @@ class Runtime:
             return
         if mtype == "done":
             self._on_task_done(handle, msg)
+        elif mtype == "log":
+            self._print_worker_log(handle, msg["data"])
         elif mtype == "stolen":
             self._on_tasks_stolen(handle, msg)
         elif mtype == "actor_created":
@@ -685,6 +691,31 @@ class Runtime:
             # nested-call requests from user code in the worker; may block on
             # futures, so never service them on the router thread
             self._request_pool.submit(self._serve_worker_request, handle, msg)
+
+    def _print_worker_log(self, handle: WorkerHandle, data: bytes) -> None:
+        """Worker stdout/stderr chunk -> driver output, one prefixed line at
+        a time (the reference's log monitor format, ``(pid=..., ip=...)``).
+        Chunks are joined per worker so a line split across reads does not
+        print as two."""
+        import sys
+
+        wid = handle.worker_id
+        buf = self._log_tails.get(wid, b"") + data
+        lines, sep, tail = buf.rpartition(b"\n")
+        self._log_tails[wid] = tail
+        if not sep:
+            return
+        prefix = (f"(worker={wid.hex()[:8]} "
+                  f"node={handle.node_id.hex()[:8]}) ")
+        out = "".join(
+            prefix + line + "\n"
+            for line in lines.decode("utf-8", "replace").split("\n")
+        )
+        try:
+            sys.stderr.write(out)
+            sys.stderr.flush()
+        except (OSError, ValueError):
+            pass
 
     # ------------------------------------------------------- task submission
     def submit_task(self, payload: dict) -> List[bytes]:
@@ -1686,28 +1717,59 @@ class Runtime:
 
     def wait(self, oids: List[bytes], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
+        """Event-driven wait: park on the objects' completion futures
+        (FIRST_COMPLETED) instead of polling — the 1 ms busy-poll burned a
+        core-share and added latency at scale (the reference's WaitManager
+        is likewise callback-driven, wait_manager.h)."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as futures_wait
+
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[bytes] = []
-        pending = list(oids)
-        while True:
-            still = []
-            for oid in pending:
-                with self._lock:
-                    fut = self.futures.get(oid)
-                    present = oid in self.memory_store
-                if present or (fut is not None and fut.done()):
-                    ready.append(oid)
-                elif fut is None and self.gcs.get_object_locations(oid):
+        pending: List[Tuple[bytes, Optional[Future]]] = []
+        with self._lock:
+            for oid in oids:
+                fut = self.futures.get(oid)
+                if (oid in self.memory_store
+                        or (fut is not None and fut.done())
+                        or (fut is None
+                            and self.gcs.get_object_locations(oid))):
                     ready.append(oid)
                 else:
-                    still.append(oid)
+                    pending.append((oid, fut))
+        while len(ready) < num_returns and pending:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            futs = {f for _, f in pending if f is not None}
+            untracked = len(futs) < len(pending)
+            if futs:
+                # untracked ids (no owner future) surface only via GCS
+                # location updates the futures can't signal — cap the park
+                # so they are re-polled even while futures stay pending
+                park = remaining
+                if untracked:
+                    park = 0.05 if remaining is None else min(remaining,
+                                                              0.05)
+                done, _ = futures_wait(futs, timeout=park,
+                                       return_when=FIRST_COMPLETED)
+                if not done and not untracked:
+                    break  # timed out
+            else:
+                if remaining == 0.0:
+                    break
+                time.sleep(min(0.05, remaining or 0.05))
+            still = []
+            for oid, fut in pending:
+                if (fut is not None and fut.done()) or (
+                        fut is None and self.gcs.get_object_locations(oid)):
+                    ready.append(oid)
+                else:
+                    still.append((oid, fut))
             pending = still
-            if len(ready) >= num_returns or not pending:
-                break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.001)
-        return ready[:num_returns] + ready[num_returns:], pending
+        return (ready[:num_returns] + ready[num_returns:],
+                [oid for oid, _ in pending])
 
     def future_for(self, ref: ObjectRef) -> Future:
         with self._lock:

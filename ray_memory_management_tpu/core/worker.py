@@ -681,11 +681,45 @@ class Worker:
             f"actor::{msg.get('name', msg['method'])}", t0)
         self.sender.send(reply)
 
+    # -- log streaming --------------------------------------------------------
+    def start_output_capture(self) -> None:
+        """Redirect this process's stdout/stderr (fd level, so native writes
+        are caught too) into an in-band pipe whose drain thread ships chunks
+        to the owner as ``log`` frames. The driver prints them prefixed with
+        the worker identity — the log-monitor-tails-to-driver behavior of
+        the reference (services.py:1126), collapsed onto the worker pipe
+        (which also carries them through the node-agent tunnel, so REMOTE
+        workers' prints reach the driver the same way)."""
+        import sys
+
+        r, w = os.pipe()
+        os.dup2(w, 1)
+        os.dup2(w, 2)
+        os.close(w)
+        # line buffering so a task's print() ships before the task blocks
+        sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+        sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+
+        def drain() -> None:
+            while True:
+                try:
+                    chunk = os.read(r, 65536)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                self.sender.send({"type": "log", "data": chunk})
+
+        threading.Thread(target=drain, daemon=True,
+                         name="log-capture").start()
+
     # -- main loop ------------------------------------------------------------
     def run(self) -> None:
         from .. import _worker_context
 
         _worker_context.set_proxy(self.proxy)
+        if os.environ.get("RMT_LOG_TO_DRIVER") == "1":
+            self.start_output_capture()
         # registration doubles as the ready signal (exec-then-connect
         # handshake; the runtime binds this connection to our WorkerHandle)
         self.sender.send({"type": "ready", "worker_id": self.worker_id,

@@ -28,9 +28,11 @@ def main() -> None:
         try:
             conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
             break
-        except (FileNotFoundError, ConnectionRefusedError):
-            # runtime already shut down (or not yet listening): exit quietly —
-            # we are a pooled worker nobody will miss
+        except (FileNotFoundError, ConnectionRefusedError,
+                ConnectionResetError, EOFError, OSError):
+            # runtime already shut down (or not yet listening, or tearing
+            # down mid-handshake): exit quietly — we are a pooled worker
+            # nobody will miss
             time.sleep(0.1 * (attempt + 1))
     if conn is None:
         return
