@@ -1,0 +1,370 @@
+"""Cluster launcher: ``rmt up / down / exec / submit`` over a cluster YAML.
+
+The reference's launcher (python/ray/autoscaler/_private/commands.py behind
+``ray up/down/attach/exec``, scripts.py:1165-1623) provisions cloud nodes,
+then boots a head and workers over SSH. Here the same lifecycle targets a
+TPU-pod-like fleet:
+
+  - the HEAD is a detached ``rmt head`` process: an rmt runtime + thin-client
+    server (client/server.py) + the node-agent TCP listener;
+  - WORKERS are node agents (core/node_agent.py) joined to the head, one per
+    host, launched through a NodeProvider;
+  - providers: ``subprocess`` (this host — the fake_multi_node analog used
+    by tests and single-host pods) and ``ssh`` (one agent per remote host,
+    the reference's command-runner path; exercised in tests by overriding
+    the ssh binary).
+
+Cluster state (head pid, ports, worker pids) persists in
+``~/.rmt/clusters/<name>.json`` so ``down``/``exec`` find the cluster the
+way the reference keeps cluster state under ``~/.ray``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+STATE_DIR = os.path.expanduser("~/.rmt/clusters")
+
+
+# ------------------------------------------------------------------ config
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    cfg.setdefault("cluster_name", "default")
+    cfg.setdefault("provider", {"type": "subprocess"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("workers", [])
+    return cfg
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    return os.path.join(STATE_DIR, f"{name}.json")
+
+
+def load_state(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def save_state(name: str, state: Dict[str, Any]) -> None:
+    with open(_state_path(name), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+# ---------------------------------------------------------------- providers
+class NodeProvider:
+    """Launches one node agent per worker entry (the reference's
+    NodeProvider + command-runner pair, autoscaler/_private/*)."""
+
+    def launch_worker(self, spec: Dict[str, Any], head_addr: str,
+                      authkey_hex: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def terminate_worker(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class SubprocessProvider(NodeProvider):
+    """Workers as local agent processes — the fake_multi_node analog
+    (autoscaler/_private/fake_multi_node) and the single-host-pod case."""
+
+    def __init__(self, log_dir: str = ""):
+        self.log_dir = log_dir
+        self._count = 0
+
+    def launch_worker(self, spec, head_addr, authkey_hex):
+        self._count += 1
+        log = _daemon_log(self.log_dir, f"worker-{self._count}")
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "ray_memory_management_tpu.core.node_agent",
+             "--address", head_addr, "--authkey", authkey_hex,
+             "--num-cpus", str(spec.get("num_cpus", 4)),
+             "--num-tpus", str(spec.get("num_tpus", 0))],
+            close_fds=True, **log,
+        )
+        return {"kind": "subprocess", "pid": proc.pid}
+
+    def terminate_worker(self, record):
+        try:
+            os.kill(record["pid"], signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class SSHProvider(NodeProvider):
+    """One agent per remote host over ssh (the command-runner path,
+    autoscaler/_private/command_runner.py). The ssh binary is
+    configurable so tests can substitute a local shim."""
+
+    def __init__(self, provider_cfg: Dict[str, Any], log_dir: str = ""):
+        self.ssh = provider_cfg.get("ssh_command", "ssh")
+        self.user = provider_cfg.get("ssh_user", "")
+        self.opts = provider_cfg.get("ssh_options",
+                                     ["-o", "StrictHostKeyChecking=no"])
+        self.python = provider_cfg.get("remote_python", "python3")
+        self.log_dir = log_dir
+
+    def launch_worker(self, spec, head_addr, authkey_hex):
+        host = spec["host"]
+        target = f"{self.user}@{host}" if self.user else host
+        remote_cmd = (
+            f"{self.python} -m ray_memory_management_tpu.core.node_agent "
+            f"--address {head_addr} --authkey {authkey_hex} "
+            f"--num-cpus {spec.get('num_cpus', 4)} "
+            f"--num-tpus {spec.get('num_tpus', 0)}"
+        )
+        proc = subprocess.Popen([self.ssh, *self.opts, target, remote_cmd],
+                                close_fds=True,
+                                **_daemon_log(self.log_dir, f"ssh-{host}"))
+        return {"kind": "ssh", "pid": proc.pid, "host": host}
+
+    def terminate_worker(self, record):
+        # killing the local ssh client drops the channel; the agent exits
+        # on channel EOF (its run loop returns when the head/pipe is gone)
+        try:
+            os.kill(record["pid"], signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _daemon_log(log_dir: str, tag: str) -> Dict[str, Any]:
+    """Popen kwargs detaching a daemon's stdio from the caller: inheriting
+    the CLI's pipes would keep e.g. ``subprocess.run(capture_output=True)``
+    callers blocked on pipe EOF for as long as the daemon lives. Output
+    goes to a log file when a log_dir is known (the reference keeps head /
+    raylet logs under the session dir), else /dev/null."""
+    if not log_dir:
+        return {"stdin": subprocess.DEVNULL, "stdout": subprocess.DEVNULL,
+                "stderr": subprocess.DEVNULL}
+    os.makedirs(log_dir, exist_ok=True)
+    f = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+    return {"stdin": subprocess.DEVNULL, "stdout": f, "stderr": f}
+
+
+def make_provider(provider_cfg: Dict[str, Any],
+                  log_dir: str = "") -> NodeProvider:
+    kind = provider_cfg.get("type", "subprocess")
+    if kind == "subprocess":
+        return SubprocessProvider(log_dir)
+    if kind == "ssh":
+        return SSHProvider(provider_cfg, log_dir)
+    raise ValueError(f"unknown provider type: {kind}")
+
+
+# --------------------------------------------------------------- lifecycle
+def up(config_path: str, wait_s: float = 60.0) -> Dict[str, Any]:
+    """Boot the head process and all workers; returns the cluster state."""
+    cfg = load_cluster_config(config_path)
+    name = cfg["cluster_name"]
+    existing = load_state(name)
+    if existing and _pid_alive(existing.get("head_pid")):
+        raise RuntimeError(f"cluster '{name}' is already up "
+                           f"(head pid {existing['head_pid']})")
+
+    info_path = _state_path(name) + ".head"
+    try:
+        os.unlink(info_path)
+    except OSError:
+        pass
+    head_cfg = cfg["head"]
+    env = dict(os.environ)
+    env["RMT_HEAD_INFO_PATH"] = info_path
+    env["RMT_HEAD_NUM_CPUS"] = str(head_cfg.get("num_cpus", 4))
+    env["RMT_HEAD_NUM_TPUS"] = str(head_cfg.get("num_tpus", 0))
+    env["RMT_HEAD_CLIENT_PORT"] = str(head_cfg.get("client_port", 0))
+    log_dir = os.path.join(STATE_DIR, f"{name}.logs")
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_memory_management_tpu.launcher"],
+        env=env, close_fds=True, **_daemon_log(log_dir, "head"),
+    )
+    deadline = time.monotonic() + wait_s
+    info = None
+    while time.monotonic() < deadline:
+        if head.poll() is not None:
+            raise RuntimeError(f"head exited rc={head.returncode}")
+        try:
+            with open(info_path) as f:
+                info = json.load(f)
+            break
+        except (OSError, ValueError):
+            time.sleep(0.2)
+    if info is None:
+        head.kill()
+        raise TimeoutError("head did not come up in time")
+
+    provider = make_provider(cfg["provider"], log_dir)
+    workers = [provider.launch_worker(spec, info["node_listener"],
+                                      info["authkey"])
+               for spec in cfg["workers"]]
+    # state is saved BEFORE the readiness wait so a slow/unreachable worker
+    # leaves a cluster `rmt down` can still find and clean up
+    state = {
+        "cluster_name": name,
+        "config_path": os.path.abspath(config_path),
+        "head_pid": head.pid,
+        "client_address": info["client_address"],
+        "node_listener": info["node_listener"],
+        "workers": workers,
+        "provider": cfg["provider"],
+    }
+    save_state(name, state)
+    # ray-up waits until workers are usable; here that means the agents
+    # registered and the cluster's aggregate CPU covers every node
+    want_cpus = (head_cfg.get("num_cpus", 4)
+                 + sum(w.get("num_cpus", 4) for w in cfg["workers"]))
+    _wait_for_cpus(info["client_address"], want_cpus,
+                   deadline - time.monotonic() + wait_s)
+    return state
+
+
+def down(config_or_name: str) -> bool:
+    """Tear the cluster down (``ray down`` analog)."""
+    name = config_or_name
+    if os.path.exists(config_or_name):
+        name = load_cluster_config(config_or_name)["cluster_name"]
+    state = load_state(name)
+    if state is None:
+        return False
+    provider = make_provider(state.get("provider", {}))
+    for rec in state.get("workers", []):
+        provider.terminate_worker(rec)
+    head_pid = state.get("head_pid")
+    if _pid_alive(head_pid):
+        _kill_quietly(head_pid, signal.SIGTERM)
+        for attempt in range(100):
+            _reap(head_pid)
+            if not _pid_alive(head_pid):
+                break
+            if attempt == 50:  # graceful shutdown is taking too long
+                _kill_quietly(head_pid, signal.SIGKILL)
+            time.sleep(0.1)
+    try:
+        os.unlink(_state_path(name))
+    except OSError:
+        pass
+    return True
+
+
+def client_address(config_or_name: str) -> str:
+    name = config_or_name
+    if os.path.exists(config_or_name):
+        name = load_cluster_config(config_or_name)["cluster_name"]
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"cluster '{name}' is not up")
+    return state["client_address"]
+
+
+def exec_script(config_or_name: str, script: List[str]) -> int:
+    """Run a command with RMT_CLIENT_ADDRESS pointing at the cluster
+    (``ray exec``/``ray submit`` analog — the script connects via
+    client.connect(os.environ['RMT_CLIENT_ADDRESS']))."""
+    env = dict(os.environ)
+    env["RMT_CLIENT_ADDRESS"] = client_address(config_or_name)
+    return subprocess.call(script, env=env)
+
+
+def _wait_for_cpus(client_address: str, want_cpus: float,
+                   timeout: float) -> None:
+    """Poll the head's cluster_resources through the thin-client port
+    until every launched node has registered its CPUs."""
+    from multiprocessing.connection import Client as _Client
+
+    host, port = client_address.rsplit(":", 1)
+    deadline = time.monotonic() + max(5.0, timeout)
+    while time.monotonic() < deadline:
+        try:
+            conn = _Client((host, int(port)), authkey=b"rmt-client")
+            try:
+                conn.send({"type": "cluster_resources", "req_id": 1})
+                reply = conn.recv()
+            finally:
+                conn.close()
+            if reply.get("resources", {}).get("CPU", 0) >= want_cpus:
+                return
+        except (OSError, EOFError, ValueError):
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"workers did not register {want_cpus} CPUs in time")
+
+
+def _kill_quietly(pid, sig) -> None:
+    try:
+        os.kill(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass  # exited on its own between the liveness check and the kill
+
+
+def _reap(pid) -> None:
+    """Collect the exit status if ``pid`` is our zombie child (a SIGKILLed
+    child stays kill-0-visible until waited, which would make _pid_alive
+    lie forever)."""
+    try:
+        os.waitpid(pid, os.WNOHANG)
+    except ChildProcessError:
+        pass  # not our child (down() from another process) — init reaps it
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+    except OSError:
+        return False
+
+
+# ------------------------------------------------------------- head process
+def _head_main() -> int:
+    """Entry point of the detached head process (``rmt up`` spawns this):
+    an rmt runtime serving thin clients + node agents until SIGTERM."""
+    import threading
+
+    import ray_memory_management_tpu as rmt
+    from ray_memory_management_tpu.client import ClusterServer
+
+    rt = rmt.init(
+        num_cpus=int(os.environ.get("RMT_HEAD_NUM_CPUS", "4")),
+        num_tpus=int(os.environ.get("RMT_HEAD_NUM_TPUS", "0")),
+    )
+    server = ClusterServer(port=int(os.environ.get("RMT_HEAD_CLIENT_PORT",
+                                                   "0")))
+    host, port = rt.node_listener_address
+    info = {
+        "client_address": f"127.0.0.1:{server.port}",
+        "node_listener": f"{host}:{port}",
+        "authkey": rt._authkey.hex(),
+        "pid": os.getpid(),
+    }
+    info_path = os.environ["RMT_HEAD_INFO_PATH"]
+    with open(info_path + ".tmp", "w") as f:
+        json.dump(info, f)
+    os.replace(info_path + ".tmp", info_path)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    rmt.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_head_main())

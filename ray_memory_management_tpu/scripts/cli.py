@@ -29,6 +29,46 @@ def cmd_agent(args) -> int:
     ])
 
 
+def cmd_up(args) -> int:
+    from ray_memory_management_tpu import launcher
+
+    state = launcher.up(args.config)
+    print(f"cluster '{state['cluster_name']}' is up")
+    print(f"  head pid:       {state['head_pid']}")
+    print(f"  client address: {state['client_address']}")
+    print(f"  node listener:  {state['node_listener']}")
+    print(f"  workers:        {len(state['workers'])}")
+    print("connect with: from ray_memory_management_tpu.client import "
+          f"connect; connect(\"{state['client_address']}\")")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_memory_management_tpu import launcher
+
+    if launcher.down(args.config):
+        print("cluster stopped")
+        return 0
+    print("no such cluster (already down?)")
+    return 1
+
+
+def cmd_exec(args) -> int:
+    from ray_memory_management_tpu import launcher
+
+    if not args.command:
+        print("rmt exec: no command given "
+              "(usage: rmt exec CONFIG -- CMD [ARGS...])", file=sys.stderr)
+        return 2
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("rmt exec: no command given", file=sys.stderr)
+        return 2
+    return launcher.exec_script(args.config, command)
+
+
 def cmd_status(args) -> int:
     import ray_memory_management_tpu as rmt
 
@@ -169,6 +209,27 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="show cluster resources")
     s.add_argument("--num-nodes", type=int, default=1)
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser(
+        "up",
+        help="boot a cluster from a YAML config: a detached head serving "
+             "thin clients plus one node agent per worker entry "
+             "('ray up' analog)")
+    s.add_argument("config", help="cluster YAML path")
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="tear a cluster down ('ray down')")
+    s.add_argument("config", help="cluster YAML path or cluster name")
+    s.set_defaults(fn=cmd_down)
+
+    s = sub.add_parser(
+        "exec",
+        help="run a command against a running cluster: RMT_CLIENT_ADDRESS "
+             "is set for the child ('ray exec'/'ray submit' analog)")
+    s.add_argument("config", help="cluster YAML path or cluster name")
+    s.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command (and args) to run")
+    s.set_defaults(fn=cmd_exec)
 
     s = sub.add_parser(
         "agent",

@@ -1,0 +1,105 @@
+"""Chaos/fault-injection harness: kill nodes at random under load.
+
+The reference's NodeKillerActor (python/ray/_private/test_utils.py:1089-1207,
+wired into chaos release tests by release/nightly_tests/setup_chaos.py) kills
+random raylets on an interval while a workload runs, asserting the workload
+survives via retries + lineage reconstruction. This is the same tool for this
+runtime's two node planes:
+
+  - in-process nodes: ``Runtime.remove_node`` (graceful-crash analog);
+  - node-agent processes: SIGKILL the agent, exercising channel-EOF death
+    detection exactly like a host loss.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+
+class NodeKiller:
+    """Periodically kills a random non-head node while running.
+
+    kill_mode:
+      - "remove": graceful in-process node removal (workers terminated,
+        store dropped) — works for every node type;
+      - "sigkill": for remote agent nodes only, kill -9 the agent process
+        (no goodbye; the head must detect the death from channel EOF).
+    """
+
+    def __init__(self, runtime, interval_s: float = 1.0,
+                 max_kills: int = 1, kill_mode: str = "remove",
+                 rng: Optional[random.Random] = None):
+        self._rt = runtime
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kill_mode = kill_mode
+        self.kills: list = []  # NodeIDs killed
+        self._rng = rng or random.Random(0xC4A05)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- the chaos loop -------------------------------------------------------
+    def _victims(self):
+        rt = self._rt
+        head = rt.head_node().node_id
+        out = []
+        for node_id, nm in list(rt.nodes.items()):
+            if node_id == head or not nm.alive:
+                continue
+            if self.kill_mode == "sigkill":
+                from ..core.remote_node import RemoteNodeManager
+
+                if not isinstance(nm, RemoteNodeManager):
+                    continue
+            out.append(node_id)
+        return out
+
+    def kill_one(self) -> Optional[object]:
+        """Kill one random eligible node now; returns its NodeID or None."""
+        victims = self._victims()
+        if not victims:
+            return None
+        node_id = self._rng.choice(victims)
+        if self.kill_mode == "sigkill":
+            self._sigkill_agent(node_id)
+        else:
+            self._rt.remove_node(node_id)
+        self.kills.append(node_id)
+        return node_id
+
+    def _sigkill_agent(self, node_id) -> None:
+        """SIGKILL the agent process for EXACTLY the chosen node (its pid
+        arrives in the registration hello and is recorded on the head-side
+        RemoteNodeManager). Only meaningful for same-host agents — a chaos
+        harness for true remote hosts kills over ssh instead."""
+        import os
+        import signal
+
+        pid = self._rt.nodes[node_id].agent_pid
+        if pid is None:
+            raise RuntimeError(f"node {node_id} has no recorded agent pid")
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set() and len(self.kills) < self.max_kills:
+            if self._stop.wait(self.interval_s):
+                return
+            self.kill_one()
