@@ -39,6 +39,13 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     attention: str = "auto"           # auto|flash|ref|ring|ulysses
     remat: bool = False               # jax.checkpoint each block
+    # Mixture-of-Experts FFN (ops/moe.py); 0 = dense MLP. Net-new vs the
+    # reference (SURVEY.md §2.4: EP absent there).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    expert_group_size: int = 256      # tokens per dispatch group (GShard G)
+    moe_aux_weight: float = 0.01      # load-balancing loss weight
 
     @property
     def kv_heads(self) -> int:
@@ -61,6 +68,13 @@ class TransformerConfig:
 PRESETS: Dict[str, TransformerConfig] = {
     "test": TransformerConfig(vocab_size=512, d_model=64, n_layers=2,
                               n_heads=4, max_seq=128),
+    "test-moe": TransformerConfig(vocab_size=512, d_model=64, n_layers=2,
+                                  n_heads=4, max_seq=128, n_experts=4,
+                                  expert_top_k=2),
+    "mixtral-tiny": TransformerConfig(vocab_size=32_000, d_model=1024,
+                                      n_layers=8, n_heads=16, n_kv_heads=4,
+                                      max_seq=2048, n_experts=8,
+                                      expert_top_k=2),
     "gpt2-small": TransformerConfig(vocab_size=50_304, d_model=768,
                                     n_layers=12, n_heads=12, max_seq=1024),
     "gpt2-medium": TransformerConfig(vocab_size=50_304, d_model=1024,
@@ -83,19 +97,28 @@ def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
     def dense(k, shape, fan_in):
         return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5))
 
-    return {
-        "tok_embed": dense(keys[0], (cfg.vocab_size, D), D),
-        "layers": {
-            "ln1": jnp.ones((L, D), pd),
-            "ln2": jnp.ones((L, D), pd),
-            "wq": dense(keys[1], (L, D, H * Dh), D),
-            "wk": dense(keys[2], (L, D, Hkv * Dh), D),
-            "wv": dense(keys[3], (L, D, Hkv * Dh), D),
-            "wo": dense(keys[4], (L, H * Dh, D), H * Dh),
+    layers = {
+        "ln1": jnp.ones((L, D), pd),
+        "ln2": jnp.ones((L, D), pd),
+        "wq": dense(keys[1], (L, D, H * Dh), D),
+        "wk": dense(keys[2], (L, D, Hkv * Dh), D),
+        "wv": dense(keys[3], (L, D, Hkv * Dh), D),
+        "wo": dense(keys[4], (L, H * Dh, D), H * Dh),
+    }
+    if cfg.n_experts > 0:
+        from ..ops import moe
+
+        layers.update(moe.init_moe_params(keys[5], L, D, F, cfg.n_experts,
+                                          pd))
+    else:
+        layers.update({
             "w1": dense(keys[5], (L, D, F), D),
             "w3": dense(keys[6], (L, D, F), D),
             "w2": dense(keys[7], (L, F, D), F),
-        },
+        })
+    return {
+        "tok_embed": dense(keys[0], (cfg.vocab_size, D), D),
+        "layers": layers,
         "final_ln": jnp.ones((D,), pd),
         "lm_head": dense(keys[0], (D, cfg.vocab_size), D),
     }
@@ -139,17 +162,18 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh, sp_axis):
     return flash_attention(q, k, v, causal=True, use_pallas=use)
 
 
-def apply_block(x, layer, cfg: TransformerConfig, mesh=None, sp_axis=None,
-                attn_fn=None, positions=None):
-    """One transformer block: x [B, S, D] + per-layer weight dict -> [B, S, D].
+def apply_block_with_aux(x, layer, cfg: TransformerConfig, mesh=None,
+                         sp_axis=None, attn_fn=None, positions=None):
+    """One transformer block; returns (x, attn_aux, moe_aux).
+
     Shapes derive from ``x`` so the same block serves the full forward, the
     pipeline-parallel schedule (parallel/pipeline.py), and the KV-cached
-    decode path.
-
-    ``attn_fn``, if given, replaces the standard attention middle: it takes
-    post-rope q/k/v as [B, S, H(kv), Dh] and returns (o [B, S, H, Dh], aux);
-    apply_block then returns (x, aux). The cached decode uses this hook to
-    read/update its cache without duplicating the block math."""
+    decode path. ``attn_fn``, if given, replaces the standard attention
+    middle: it takes post-rope q/k/v as [B, S, H(kv), Dh] and returns
+    (o [B, S, H, Dh], attn_aux) — the cached decode uses this hook to
+    read/update its cache without duplicating the block math. The FFN is
+    dense or MoE (ops/moe.py) per cfg.n_experts; moe_aux is the layer's
+    load-balancing loss (0.0 when dense)."""
     B, S = x.shape[0], x.shape[1]
     H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     if positions is None:
@@ -160,36 +184,56 @@ def apply_block(x, layer, cfg: TransformerConfig, mesh=None, sp_axis=None,
     v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    aux = None
+    attn_aux = None
     if attn_fn is not None:
-        o, aux = attn_fn(q, k, v)
+        o, attn_aux = attn_fn(q, k, v)
     else:
         o = _attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                        v.transpose(0, 2, 1, 3), cfg, mesh, sp_axis)
         o = o.transpose(0, 2, 1, 3)
     x = x + o.reshape(B, S, H * Dh) @ layer["wo"].astype(cfg.dtype)
     h = _rmsnorm(x, layer["ln2"])
-    gate = jax.nn.silu(h @ layer["w1"].astype(cfg.dtype))
-    up = h @ layer["w3"].astype(cfg.dtype)
-    x = x + (gate * up) @ layer["w2"].astype(cfg.dtype)
+    if cfg.n_experts > 0:
+        from ..ops import moe
+
+        y, moe_aux = moe.moe_ffn(h, layer, cfg, mesh)
+        x = x + y
+    else:
+        gate = jax.nn.silu(h @ layer["w1"].astype(cfg.dtype))
+        up = h @ layer["w3"].astype(cfg.dtype)
+        x = x + (gate * up) @ layer["w2"].astype(cfg.dtype)
+        moe_aux = jnp.float32(0.0)
+    return x, attn_aux, moe_aux
+
+
+def apply_block(x, layer, cfg: TransformerConfig, mesh=None, sp_axis=None,
+                attn_fn=None, positions=None):
+    """apply_block_with_aux with the historical contract: returns x, or
+    (x, attn_aux) when attn_fn is given. MoE aux is dropped here — callers
+    that train MoE configs (forward/loss_fn) use the _with_aux variant."""
+    x, attn_aux, _ = apply_block_with_aux(x, layer, cfg, mesh, sp_axis,
+                                          attn_fn, positions)
     if attn_fn is not None:
-        return x, aux
+        return x, attn_aux
     return x
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
-    """tokens [B, S] -> logits [B, S, V] (fp32)."""
+def forward_with_aux(params, tokens, cfg: TransformerConfig, mesh=None,
+                     sp_axis=None):
+    """tokens [B, S] -> (logits [B, S, V] fp32, aux scalar): the mean
+    per-layer MoE load-balancing loss (0.0 for dense configs)."""
     x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     def block(x, layer):
-        return apply_block(x, layer, cfg, mesh, sp_axis)
+        x, _, moe_aux = apply_block_with_aux(x, layer, cfg, mesh, sp_axis)
+        return x, moe_aux
 
     block_fn = jax.checkpoint(block) if cfg.remat else block
 
     def scan_body(x, layer):
-        return block_fn(x, layer), None
+        return block_fn(x, layer)
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, aux = lax.scan(scan_body, x, params["layers"])
     x = _rmsnorm(x, params["final_ln"])
     # bf16 operands on the MXU, fp32 accumulation/output — fp32 operands
     # would run the largest matmul in the model at a fraction of MXU rate
@@ -198,20 +242,30 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return logits
+    return logits, jnp.mean(aux)
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None, sp_axis=None):
+    """tokens [B, S] -> logits [B, S, V] (fp32)."""
+    return forward_with_aux(params, tokens, cfg, mesh, sp_axis)[0]
 
 
 def loss_fn(params, batch, cfg: TransformerConfig, mesh=None, sp_axis=None):
-    """batch: {"tokens": [B, S], "targets": [B, S]} -> mean xent.
+    """batch: {"tokens": [B, S], "targets": [B, S]} -> mean xent (+ the
+    MoE load-balancing aux, weighted, for expert configs).
 
     Fused form: mean(logsumexp(logits) - logits[target]) — never
     materialises log_softmax's [B, S, V] residual, which is the difference
     between fitting batch 16 and OOMing on a 16 GB chip."""
-    logits = forward(params, batch["tokens"], cfg, mesh, sp_axis)
+    logits, aux = forward_with_aux(params, batch["tokens"], cfg, mesh,
+                                   sp_axis)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     take = jnp.take_along_axis(logits, batch["targets"][..., None],
                                axis=-1)[..., 0]
-    return jnp.mean(lse - take)
+    xent = jnp.mean(lse - take)
+    if cfg.n_experts > 0:
+        xent = xent + cfg.moe_aux_weight * aux
+    return xent
 
 
 def count_params(params) -> int:

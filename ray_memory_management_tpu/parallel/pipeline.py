@@ -56,6 +56,7 @@ def pipeline_blocks(
     axis: str = "pp",
     n_microbatches: int = 0,
     batch_axes: tuple = (),
+    with_aux: bool = False,
 ):
     """Run ``x`` through L stacked layers pipelined over the ``axis`` stages.
 
@@ -65,6 +66,10 @@ def pipeline_blocks(
     over ``axis``. x: [B, ...] activations (replicated over ``axis``;
     optionally sharded over ``batch_axes`` — e.g. ("dp",) — in which case B
     here is the per-shard batch). Returns [B, ...] like a plain layer scan.
+
+    with_aux: block_fn returns (h, aux_scalar) per layer — e.g. the MoE
+    load-balancing loss — and pipeline_blocks returns (out, mean_aux).
+    Aux from bubble steps (fill/flush garbage microbatches) is masked out.
 
     Schedule: step t of M+S-1 —
       stage 0 consumes microbatch min(t, M-1); stage s consumes what stage
@@ -93,11 +98,12 @@ def pipeline_blocks(
 
     bspec = P(batch_axes if batch_axes else None)
     param_specs = stacked_param_pspecs(stacked_params, axis)
+    out_specs = (bspec, P()) if with_aux else bspec
 
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(param_specs, bspec),
-        out_specs=bspec,
+        out_specs=out_specs,
         check_vma=False,
     )
     def run(params_local, x_local):
@@ -107,18 +113,26 @@ def pipeline_blocks(
 
         def stage_apply(h):
             def body(h, layer):
-                return block_fn(h, layer), None
+                if with_aux:
+                    h, aux = block_fn(h, layer)
+                    return h, aux
+                return block_fn(h, layer), jnp.float32(0.0)
 
-            h, _ = lax.scan(body, h, params_local)
-            return h
+            h, layer_aux = lax.scan(body, h, params_local)
+            return h, jnp.sum(layer_aux)
 
         def step(carry, t):
-            state, outputs = carry
+            state, outputs, aux_sum = carry
             # stage 0 injects microbatch t (clamped during the flush tail)
             x_t = lax.dynamic_index_in_dim(
                 mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
             h_in = jnp.where(stage == 0, x_t, state)
-            y = stage_apply(h_in)
+            y, aux = stage_apply(h_in)
+            # this stage processes microbatch t-stage; only those steps
+            # carry real data (fill/flush steps see garbage activations)
+            mb = t - stage
+            real = (mb >= 0) & (mb < M)
+            aux_sum = aux_sum + jnp.where(real, aux, 0.0)
             # the last stage emits microbatch t-(S-1) during the drain
             out_t = t - (S - 1)
             valid = (out_t >= 0) & (stage == S - 1)
@@ -130,17 +144,28 @@ def pipeline_blocks(
             # hand this stage's activation to the next stage over ICI
             state = lax.ppermute(
                 y, axis, [(i, (i + 1) % S) for i in range(S)])
-            return (state, outputs), None
+            return (state, outputs, aux_sum), None
 
         state0 = jnp.zeros_like(mbs[0])
         outputs0 = jnp.zeros_like(mbs)
-        (_, outputs), _ = lax.scan(
-            step, (state0, outputs0), jnp.arange(M + S - 1))
+        (_, outputs, aux_sum), _ = lax.scan(
+            step, (state0, outputs0, jnp.float32(0.0)),
+            jnp.arange(M + S - 1))
         # results live on the last stage only; psum broadcasts them so the
         # caller sees a pp-replicated activation (zeros elsewhere)
         outputs = jnp.where(stage == S - 1, outputs, 0)
         outputs = lax.psum(outputs, axis)
-        return outputs.reshape(b, *x_local.shape[1:])
+        out = outputs.reshape(b, *x_local.shape[1:])
+        if with_aux:
+            # sum over stages (each stage saw its own layers), mean over
+            # the M microbatches, the L/S layers per stage, and any batch
+            # shards (each dp shard routed different tokens)
+            total_aux = lax.psum(aux_sum, axis)
+            for a in batch_axes:
+                total_aux = lax.pmean(total_aux, a)
+            L = jax.tree.leaves(params_local)[0].shape[0] * S
+            return out, total_aux / (M * L)
+        return out
 
     return run(stacked_params, x)
 
@@ -153,32 +178,39 @@ def pipeline_forward(params, tokens, cfg, mesh: Mesh, axis: str = "pp",
     Embedding and head are small next to the block stack; they run
     replicated over pp (sharded over ``batch_axes`` if given), while the
     [L, ...] layer stack streams microbatches through the stages.
+    Returns (logits, aux) — aux is the MoE load-balancing loss (0.0 for
+    dense configs).
     """
     from ..models import gpt
 
     x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     def block(h, layer):
-        return gpt.apply_block(h, layer, cfg)
+        h, _, moe_aux = gpt.apply_block_with_aux(h, layer, cfg)
+        return h, moe_aux
 
-    x = pipeline_blocks(block, params["layers"], x, mesh, axis=axis,
-                        n_microbatches=n_microbatches,
-                        batch_axes=batch_axes)
+    x, aux = pipeline_blocks(block, params["layers"], x, mesh, axis=axis,
+                             n_microbatches=n_microbatches,
+                             batch_axes=batch_axes, with_aux=True)
     x = gpt._rmsnorm(x, params["final_ln"])
     logits = lax.dot_general(
         x, params["lm_head"].astype(cfg.dtype),
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return logits
+    return logits, aux
 
 
 def pipeline_loss_fn(params, batch, cfg, mesh: Mesh, axis: str = "pp",
                      n_microbatches: int = 0, batch_axes: tuple = ()):
-    """Drop-in for models.gpt.loss_fn with a pipelined block stack."""
-    logits = pipeline_forward(params, batch["tokens"], cfg, mesh, axis,
-                              n_microbatches, batch_axes)
+    """Drop-in for models.gpt.loss_fn with a pipelined block stack
+    (including the weighted MoE aux for expert configs)."""
+    logits, aux = pipeline_forward(params, batch["tokens"], cfg, mesh,
+                                   axis, n_microbatches, batch_axes)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     take = jnp.take_along_axis(logits, batch["targets"][..., None],
                                axis=-1)[..., 0]
-    return jnp.mean(lse - take)
+    loss = jnp.mean(lse - take)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss

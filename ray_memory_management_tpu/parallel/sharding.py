@@ -56,18 +56,37 @@ def param_pspecs(params: Dict[str, Any], mesh: Mesh,
                  strategy: str = "dp") -> Dict[str, Any]:
     """PartitionSpec pytree for a parameter pytree.
 
-    strategy: "dp" (replicated params), "fsdp", "tp", "fsdp+tp" / "dp+tp".
-    Mesh must carry the matching axis names.
+    strategy: "dp" (replicated params), "fsdp", "tp", "ep", and
+    combinations ("fsdp+tp", "dp+tp", "ep+tp", ...). Mesh must carry the
+    matching axis names.
     """
     use_tp = "tp" in strategy and "tp" in mesh.shape
     use_fsdp = "fsdp" in strategy and "fsdp" in mesh.shape
+    use_ep = "ep" in strategy and "ep" in mesh.shape
     fsdp_size = mesh.shape.get("fsdp", 1)
+
+    # MoE expert weights ([L, E, ...], ops/moe.py): the expert dim shards
+    # over ep (when enabled); tp (if also on) stays Megatron-style WITHIN
+    # each expert (col-parallel w1/w3 output dim, row-parallel w2 input
+    # dim). These rules apply whenever the 4-D expert shape is seen — a
+    # tp-only strategy must NOT fall through to the 3-D dense rules, which
+    # would shard the expert dim as if it were a feature dim.
+    ep_ax = "ep" if use_ep else None
+    tp_ax = "tp" if use_tp else None
+    _MOE_RULES = {
+        "w1": P(None, ep_ax, None, tp_ax),
+        "w3": P(None, ep_ax, None, tp_ax),
+        "w2": P(None, ep_ax, tp_ax, None),
+        "router": P(None, None, None),  # [L, D, E]: tiny, replicated
+    }
 
     def spec_for(path: str, leaf) -> P:
         shape = leaf.shape
+        name = path.split("/")[-1]
         spec = P(*([None] * len(shape)))
-        if use_tp:
-            name = path.split("/")[-1]
+        if name in _MOE_RULES and len(_MOE_RULES[name]) == len(shape):
+            spec = _MOE_RULES[name]
+        elif use_tp:
             if name in _TP_RULES:
                 spec = _TP_RULES[name]
                 if len(spec) < len(shape):  # non-stacked variant

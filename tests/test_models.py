@@ -158,3 +158,80 @@ def test_generate_greedy_matches_recompute(small_lm):
         nxt = jnp.argmax(gpt.forward(params, toks, cfg)[:, -1], axis=-1)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+class TestMoE:
+    """Mixture-of-Experts FFN + expert parallelism (ops/moe.py) — net-new
+    vs the reference (SURVEY.md §2.4: EP absent there)."""
+
+    def test_moe_forward_and_loss(self):
+        import numpy as np
+
+        cfg = dataclasses.replace(gpt.PRESETS["test-moe"], attention="ref")
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        assert params["layers"]["w1"].shape == (2, 4, 64, cfg.ff_dim)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                  cfg.vocab_size)
+        logits, aux = gpt.forward_with_aux(params, toks, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+        # balanced-ish routing: aux near its minimum (1.0 for uniform);
+        # wildly above means collapsed routing or a broken dispatch
+        assert 0.5 < float(aux) < 4.0, float(aux)
+
+    def test_moe_trains(self):
+        import optax
+
+        cfg = dataclasses.replace(gpt.PRESETS["test-moe"], attention="ref")
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                  cfg.vocab_size)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+        opt = optax.adam(3e-3)
+        state = opt.init(params)
+        step = jax.jit(lambda p, s, b: _sgd_step(p, s, b, cfg, opt))
+        losses = []
+        for _ in range(6):
+            params, state, loss = step(params, state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_moe_capacity_drops_tokens(self):
+        """A tight capacity factor still produces finite outputs (dropped
+        tokens ride the residual)."""
+        import numpy as np
+
+        cfg = dataclasses.replace(gpt.PRESETS["test-moe"], attention="ref",
+                                  expert_capacity_factor=0.5,
+                                  expert_top_k=1)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0,
+                                  cfg.vocab_size)
+        logits = gpt.forward(params, toks, cfg)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_moe_cached_decode_matches(self):
+        """The KV-cached decode path routes through the same MoE FFN."""
+        import numpy as np
+
+        cfg = dataclasses.replace(gpt.PRESETS["test-moe"], attention="ref")
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0,
+                                    cfg.vocab_size)
+        cache = gpt.init_kv_cache(cfg, 1, 8)
+        cached, _ = gpt.forward_with_cache(params, prompt, cache, 0, cfg)
+        full = gpt.forward(params, prompt, cfg)
+        # bf16 noise can flip routing for tokens near an expert decision
+        # boundary, shifting a handful of logits substantially — require
+        # near-universal agreement rather than elementwise closeness
+        close = np.isclose(np.asarray(cached), np.asarray(full),
+                           rtol=5e-2, atol=5e-2)
+        assert close.mean() > 0.99, f"only {close.mean():.4f} close"
+
+
+def _sgd_step(params, state, batch, cfg, opt):
+    loss, grads = jax.value_and_grad(
+        lambda p: gpt.loss_fn(p, batch, cfg))(params)
+    updates, state = opt.update(grads, state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, state, loss
