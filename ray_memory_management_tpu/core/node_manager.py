@@ -87,6 +87,13 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
     RMT_WORKER_JAX_PLATFORMS=tpu on the driver to spawn TPU-capable
     workers for tasks/actors leased chips."""
     env = dict(os.environ)
+    # workers/agents must import this package from any cwd (the checkout is
+    # the install; there is no pip-installed copy to fall back on)
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if pkg_parent not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + parts)
     env.update({
         "RMT_WORKER_ID": worker_id_hex,
         "RMT_NODE_ID": node_id_hex,

@@ -454,6 +454,15 @@ class Runtime:
             raise RuntimeError("node listener disabled by config")
         host, port = self.node_listener_address
         before = set(self.nodes)
+        import os as _os
+
+        env = dict(_os.environ)
+        pkg_parent = _os.path.dirname(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))))
+        parts = [p for p in env.get("PYTHONPATH", "").split(_os.pathsep)
+                 if p]
+        if pkg_parent not in parts:
+            env["PYTHONPATH"] = _os.pathsep.join([pkg_parent] + parts)
         proc = subprocess.Popen(
             [_sys.executable, "-m",
              "ray_memory_management_tpu.core.node_agent",
@@ -461,7 +470,7 @@ class Runtime:
              "--authkey", self._authkey.hex(),
              "--num-cpus", str(num_cpus),
              "--num-tpus", str(num_tpus)],
-            close_fds=True,
+            env=env, close_fds=True,
         )
         self._agent_procs.append(proc)
         deadline = time.monotonic() + timeout

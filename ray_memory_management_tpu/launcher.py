@@ -31,6 +31,18 @@ from typing import Any, Dict, List, Optional
 
 STATE_DIR = os.path.expanduser("~/.rmt/clusters")
 
+# the package's parent dir: launched daemons and exec'd client scripts must
+# import this package regardless of their cwd/script dir (the reference gets
+# this for free from pip-installed ray; here the checkout is the install)
+_PKG_PARENT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _with_pkg_path(env: Dict[str, str]) -> Dict[str, str]:
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if _PKG_PARENT not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([_PKG_PARENT] + parts)
+    return env
+
 
 # ------------------------------------------------------------------ config
 def load_cluster_config(path: str) -> Dict[str, Any]:
@@ -93,7 +105,7 @@ class SubprocessProvider(NodeProvider):
              "--address", head_addr, "--authkey", authkey_hex,
              "--num-cpus", str(spec.get("num_cpus", 4)),
              "--num-tpus", str(spec.get("num_tpus", 0))],
-            close_fds=True, **log,
+            env=_with_pkg_path(dict(os.environ)), close_fds=True, **log,
         )
         return {"kind": "subprocess", "pid": proc.pid}
 
@@ -188,7 +200,8 @@ def up(config_path: str, wait_s: float = 60.0) -> Dict[str, Any]:
     log_dir = os.path.join(STATE_DIR, f"{name}.logs")
     head = subprocess.Popen(
         [sys.executable, "-m", "ray_memory_management_tpu.launcher"],
-        env=env, close_fds=True, **_daemon_log(log_dir, "head"),
+        env=_with_pkg_path(env), close_fds=True,
+        **_daemon_log(log_dir, "head"),
     )
     deadline = time.monotonic() + wait_s
     info = None
@@ -272,7 +285,7 @@ def exec_script(config_or_name: str, script: List[str]) -> int:
     """Run a command with RMT_CLIENT_ADDRESS pointing at the cluster
     (``ray exec``/``ray submit`` analog — the script connects via
     client.connect(os.environ['RMT_CLIENT_ADDRESS']))."""
-    env = dict(os.environ)
+    env = _with_pkg_path(dict(os.environ))
     env["RMT_CLIENT_ADDRESS"] = client_address(config_or_name)
     return subprocess.call(script, env=env)
 
