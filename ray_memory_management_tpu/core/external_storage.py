@@ -54,9 +54,93 @@ class FileSystemStorage(ExternalStorage):
             pass
 
 
+class CloudStorage(ExternalStorage):
+    """Object-storage spill tier (the reference's smart_open path, :204-230):
+    one key per object under ``<scheme>://bucket/prefix``. The transport is a
+    lazily-imported client (boto3 for s3://, google.cloud.storage for gs://) —
+    absent SDKs raise at construction with a clear message, never at spill
+    time."""
+
+    def __init__(self, uri: str):
+        self.uri = uri.rstrip("/")
+        scheme = uri.split("://", 1)[0]
+        if scheme == "s3":
+            try:
+                import boto3  # type: ignore
+            except ImportError as e:  # pragma: no cover - sdk not in image
+                raise RuntimeError(
+                    "s3:// spill storage requires boto3") from e
+            self._client = boto3.client("s3")
+            self._kind = "s3"
+        elif scheme == "gs":
+            try:
+                from google.cloud import storage as gcs  # type: ignore
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "gs:// spill storage requires google-cloud-storage"
+                ) from e
+            self._client = gcs.Client()
+            self._kind = "gs"
+        else:  # pragma: no cover - registry filters schemes
+            raise ValueError(f"unsupported cloud scheme: {scheme}")
+        rest = self.uri.split("://", 1)[1]
+        self.bucket, _, self.prefix = rest.partition("/")
+
+    def _key(self, object_id: bytes) -> str:
+        return f"{self.prefix}/{object_id.hex()}" if self.prefix \
+            else object_id.hex()
+
+    def spill(self, object_id: bytes, data: memoryview) -> str:
+        key = self._key(object_id)
+        if self._kind == "s3":
+            self._client.put_object(Bucket=self.bucket, Key=key,
+                                    Body=bytes(data))
+        else:
+            self._client.bucket(self.bucket).blob(key).upload_from_string(
+                bytes(data))
+        return f"{self.uri.split('://', 1)[0]}://{self.bucket}/{key}"
+
+    def restore(self, object_id: bytes, url: str) -> bytes:
+        key = url.split("://", 1)[1].split("/", 1)[1]
+        if self._kind == "s3":
+            return self._client.get_object(
+                Bucket=self.bucket, Key=key)["Body"].read()
+        return self._client.bucket(self.bucket).blob(key) \
+            .download_as_bytes()
+
+    def delete(self, url: str) -> None:
+        key = url.split("://", 1)[1].split("/", 1)[1]
+        try:
+            if self._kind == "s3":
+                self._client.delete_object(Bucket=self.bucket, Key=key)
+            else:
+                self._client.bucket(self.bucket).blob(key).delete()
+        except Exception:
+            pass
+
+
+# scheme -> factory(uri) registry; third-party tiers plug in the way the
+# reference's external storage is selected by the object_spilling_config
+# type field (_private/external_storage.py:316 setup_external_storage)
+_SCHEMES: Dict[str, "type"] = {
+    "s3": CloudStorage,
+    "gs": CloudStorage,
+}
+
+
+def register_storage_scheme(scheme: str, factory) -> None:
+    """Register ``factory(uri) -> ExternalStorage`` for ``scheme://`` spill
+    URIs (the custom external-storage plugin point)."""
+    _SCHEMES[scheme] = factory
+
+
 def storage_for_uri(uri: str) -> ExternalStorage:
-    if uri.startswith("file://"):
-        return FileSystemStorage(uri[len("file://"):])
     if "://" not in uri:
         return FileSystemStorage(uri)
+    scheme = uri.split("://", 1)[0]
+    factory = _SCHEMES.get(scheme)  # registry wins: file:// is overridable
+    if factory is not None:
+        return factory(uri)
+    if scheme == "file":
+        return FileSystemStorage(uri[len("file://"):])
     raise ValueError(f"unsupported spill storage uri: {uri}")

@@ -20,6 +20,7 @@ from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from ..ids import ActorID, NodeID
+from ..utils import events
 from .resources import NodeResources
 
 
@@ -111,6 +112,8 @@ class GCS:
             self._node_index += 1
             self.nodes[node_id] = info
         self.pubsub.publish("node_added", node_id)
+        events.emit("NODE_ADDED", f"node {node_id.hex()[:12]} joined",
+                    source="gcs", node_id=node_id.hex())
         return info
 
     def heartbeat(self, node_id: NodeID) -> None:
@@ -130,6 +133,10 @@ class GCS:
                     dead.append(info.node_id)
         for nid in dead:
             self.pubsub.publish("node_dead", nid)
+            events.emit("NODE_DEAD",
+                        f"node {nid.hex()[:12]} missed heartbeats",
+                        severity=events.ERROR, source="gcs",
+                        node_id=nid.hex())
         return dead
 
     def mark_node_dead(self, node_id: NodeID) -> None:
@@ -139,6 +146,9 @@ class GCS:
                 return
             info.alive = False
         self.pubsub.publish("node_dead", node_id)
+        events.emit("NODE_DEAD", f"node {node_id.hex()[:12]} marked dead",
+                    severity=events.ERROR, source="gcs",
+                    node_id=node_id.hex())
 
     def alive_nodes(self) -> List[NodeInfo]:
         with self._lock:

@@ -72,6 +72,13 @@ class MemoryMonitor:
                         log.warning(
                             "memory pressure: killed a worker to free "
                             "memory (%d kills total)", self.num_kills)
+                        from ..utils import events
+
+                        events.emit(
+                            "WORKER_OOM_KILLED",
+                            "memory pressure: killed a worker",
+                            severity=events.ERROR, source="memory_monitor",
+                            kills=self.num_kills)
             except Exception:
                 log.exception("memory monitor check failed")
             self._stop.wait(self.check_interval_s)

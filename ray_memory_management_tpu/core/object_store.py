@@ -84,6 +84,7 @@ class NodeObjectStore:
         with self._spill_lock:
             candidates = self.shm.evict_candidates(need_bytes)
             freed = 0
+            n_spilled = 0
             futures = []
             views = {}
             for oid in candidates:
@@ -106,9 +107,17 @@ class NodeObjectStore:
                 self.shm.release(oid)
                 if self.shm.delete(oid):
                     freed += nbytes
+                    n_spilled += 1
                 else:
                     # a reader raced us; keep the spill copy, reclaim later
                     pass
+            if freed:
+                from ..utils import events
+
+                events.emit("OBJECT_SPILLED",
+                            f"spilled {freed} bytes to external storage",
+                            source="object_store", bytes=freed,
+                            objects=n_spilled)
             return freed
 
     # -- read path ------------------------------------------------------------

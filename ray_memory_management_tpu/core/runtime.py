@@ -39,6 +39,7 @@ from ..exceptions import (
     WorkerCrashedError,
 )
 from ..ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ..utils import events
 from .gcs import (
     ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING, ActorRecord, GCS,
 )
@@ -1058,6 +1059,11 @@ class Runtime:
             exc = ser.loads(msg["error"])
             if rec and spec and rec.retries_left > 0 and spec.retry_exceptions:
                 rec.retries_left -= 1
+                events.emit(
+                    "TASK_RETRY",
+                    f"retrying {spec.name} after {type(exc).__name__}",
+                    severity=events.WARNING, source="core_worker",
+                    task_id=task_id.hex())
                 self._resolve_deps_then_schedule(spec)
                 return
             if rec and spec:
@@ -1417,6 +1423,10 @@ class Runtime:
             if can_retry:
                 rec.retries_left -= 1
         if can_retry:
+            events.emit("TASK_RETRY",
+                        f"retrying {spec.name} after {type(exc).__name__}",
+                        severity=events.WARNING, source="core_worker",
+                        task_id=task_id.hex())
             self._resolve_deps_then_schedule(spec)
         else:
             self._fail_task(spec, exc)
@@ -1437,6 +1447,14 @@ class Runtime:
         if restartable:
             info.record.num_restarts += 1
             self.gcs.set_actor_state(info.record.actor_id, ACTOR_RESTARTING)
+            limit = ("inf" if info.spec.max_restarts == -1
+                     else info.spec.max_restarts)
+            events.emit(
+                "ACTOR_RESTARTING",
+                f"actor {info.record.actor_id.hex()[:12]} restart "
+                f"{info.record.num_restarts}/{limit}",
+                severity=events.WARNING, source="core_worker",
+                actor_id=info.record.actor_id.hex())
             # GCS-driven restart (gcs_actor_manager.h:214 RestartActor):
             # re-run the creation task; tasks in flight at the crash retry only
             # under max_task_retries, queued ones wait for ALIVE.

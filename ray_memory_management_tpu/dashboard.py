@@ -114,6 +114,10 @@ class Dashboard:
             from .job_submission import JobSubmissionClient
 
             data = JobSubmissionClient().list_jobs()
+        elif path == "/api/events":
+            from .utils import events as _events
+
+            data = _events.list_events()
         else:
             return 404, "application/json", b'{"error": "not found"}'
         return 200, "application/json", json.dumps(data).encode()

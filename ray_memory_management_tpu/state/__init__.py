@@ -7,6 +7,7 @@ plus the GCS global-state reads in ray._private.state.
 
 from .api import (  # noqa: F401
     list_actors,
+    list_cluster_events,
     list_nodes,
     list_objects,
     list_placement_groups,

@@ -166,6 +166,16 @@ def list_placement_groups(filters=None,
     return _apply_filters(rows, filters)[:limit]
 
 
+def list_cluster_events(filters=None,
+                        limit: int = 10000) -> List[Dict[str, Any]]:
+    """Structured cluster events (the dashboard event module analog —
+    NODE_ADDED/NODE_DEAD/TASK_RETRY/ACTOR_RESTARTING/WORKER_OOM_KILLED/
+    OBJECT_SPILLED, utils/events.py)."""
+    from ..utils import events
+
+    return events.list_events(filters, limit)
+
+
 # ------------------------------------------------------------- summaries
 def summarize_tasks() -> Dict[str, Any]:
     counts = Counter(r["state"] for r in list_tasks())

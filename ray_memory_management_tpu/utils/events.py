@@ -1,0 +1,92 @@
+"""Structured cluster events (the src/ray/util/event.h analog).
+
+The reference's event framework gives every daemon a structured channel for
+operator-facing lifecycle facts — severity, source component, label, message,
+custom fields — written as JSON lines and surfaced by the dashboard's event
+module (dashboard/modules/event). Worker log lines are a different stream
+(log streaming, core/runtime.py); events are the curated, machine-parseable
+record of WHAT HAPPENED: node joined/died, actor restarted, task retried,
+worker OOM-killed, object spilled.
+
+Here: one process-global bounded buffer + an optional JSONL sink, emitters
+sprinkled through the runtime (gcs node lifecycle, retries, restarts, OOM),
+read back via ``state.api.list_cluster_events`` and the dashboard's
+``/api/events`` endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+FATAL = "FATAL"
+
+MAX_EVENTS = 10_000
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=MAX_EVENTS)
+_sink_path: Optional[str] = None
+
+
+def set_sink(path: Optional[str]) -> None:
+    """Also append every event as a JSON line to ``path`` (the reference's
+    per-component event log files under the session dir)."""
+    global _sink_path
+    _sink_path = path
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+
+def emit(label: str, message: str, severity: str = INFO,
+         source: str = "core", node_id: Optional[str] = None,
+         **fields: Any) -> Dict[str, Any]:
+    """Record one structured event. ``label`` is the stable machine key
+    (e.g. NODE_DEAD); ``fields`` carry event-specific data."""
+    ev = {
+        "event_id": uuid.uuid4().hex[:16],
+        "ts": time.time(),
+        "severity": severity,
+        "label": label,
+        "message": message,
+        "source": source,
+        "pid": os.getpid(),
+    }
+    if node_id is not None:
+        ev["node_id"] = node_id
+    if fields:
+        ev["fields"] = fields
+    with _lock:
+        _events.append(ev)
+        sink = _sink_path
+    if sink:
+        try:
+            with open(sink, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        except OSError:
+            pass
+    return ev
+
+
+def list_events(filters: Optional[Dict[str, Any]] = None,
+                limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Newest-last list of events, optionally filtered by exact match on
+    top-level keys (severity/label/source/node_id)."""
+    with _lock:
+        evs = list(_events)
+    if filters:
+        evs = [e for e in evs
+               if all(e.get(k) == v for k, v in filters.items())]
+    return evs[-limit:]
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

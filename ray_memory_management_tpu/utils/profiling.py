@@ -1,0 +1,107 @@
+"""TPU profiling: xprof traces + device-memory profiles via jax.profiler.
+
+The reference's profiling story is (a) per-worker ProfileEvents to GCS
+rendered by ``ray timeline`` (src/ray/core_worker/profiling.h:30; covered
+here by utils/timeline.py) and (b) torch-profiler integration inside Train
+(train/torch/train_loop_utils.py:232 TorchWorkerProfiler). On TPU the
+equivalent of (b) is xprof: ``jax.profiler`` captures XLA device traces
+(HLO timing, MXU utilization, HBM traffic) viewable in TensorBoard or
+Perfetto. This module is the thin, dependency-gated bridge:
+
+  - ``xprof_trace(logdir)``     capture a device trace for the enclosed code
+                                (jax.profiler.trace), and record the span in
+                                the runtime timeline so host-side task spans
+                                and device traces line up;
+  - ``annotate(name)``          a TraceAnnotation visible in xprof AND a
+                                timeline span — one annotation, both views;
+  - ``start_server(port)``      live-capture endpoint (connect TensorBoard's
+                                profile tab to localhost:<port>);
+  - ``save_device_memory_profile(path)``  HBM allocation snapshot (pprof
+                                format) — the OOM-debugging tool.
+
+All entry points degrade to no-ops with a warning when jax is unavailable
+(CPU-only driver processes), so library code can call them unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from . import timeline
+
+
+def _profiler():
+    try:
+        import jax
+
+        return jax.profiler
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def xprof_trace(logdir: str, create_perfetto_trace: bool = False):
+    """Capture an xprof/TensorBoard device trace of the enclosed block into
+    ``logdir`` (the TorchWorkerProfiler analog for XLA)."""
+    prof = _profiler()
+    start = time.time()
+    if prof is None:
+        yield
+        return
+    try:
+        with prof.trace(logdir,
+                        create_perfetto_trace=create_perfetto_trace):
+            yield
+    finally:
+        timeline.record_event("xprof_trace", "profiler", start, time.time(),
+                              extra={"logdir": logdir})
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region visible in BOTH the xprof device trace (TraceAnnotation)
+    and the runtime chrome timeline."""
+    prof = _profiler()
+    start = time.time()
+    ctx = prof.TraceAnnotation(name) if prof is not None \
+        else contextlib.nullcontext()
+    try:
+        with ctx:
+            yield
+    finally:
+        timeline.record_event(name, "annotation", start, time.time())
+
+
+_server = None
+
+
+def start_server(port: int = 9012) -> bool:
+    """Start the live profiler server (TensorBoard profile tab target).
+    Returns False when jax is unavailable."""
+    global _server
+    prof = _profiler()
+    if prof is None:
+        return False
+    if _server is None:
+        _server = prof.start_server(port)
+    return True
+
+
+def stop_server() -> None:
+    global _server
+    prof = _profiler()
+    if prof is not None and _server is not None:
+        prof.stop_server()
+        _server = None
+
+
+def save_device_memory_profile(path: str) -> Optional[str]:
+    """Dump the current device (HBM) allocation profile in pprof format
+    (``jax.profiler.save_device_memory_profile``); None if unavailable."""
+    prof = _profiler()
+    if prof is None:
+        return None
+    prof.save_device_memory_profile(path)
+    return path
