@@ -33,27 +33,61 @@ class NodeProvider:
         raise NotImplementedError
 
 
-class VirtualNodeProvider(NodeProvider):
-    """Adds/removes virtual nodes on the in-process runtime — the
-    fake_multi_node provider analog for tests and laptops."""
+class _RuntimeNodeProvider(NodeProvider):
+    """Shared bookkeeping for providers that add nodes to the local
+    runtime: tracks managed node ids and filters on cluster liveness;
+    subclasses supply the create/terminate mechanism."""
 
     def __init__(self, runtime=None):
         self._rt = runtime or _worker_context.get_runtime()
         self._managed: List[Any] = []
 
+    def _create(self, node_config: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def _terminate(self, node_id: Any) -> None:
+        raise NotImplementedError
+
     def create_node(self, node_config: Dict[str, Any]) -> Any:
-        node_id = self._rt.add_node(dict(node_config))
+        node_id = self._create(node_config)
         self._managed.append(node_id)
         return node_id
 
     def terminate_node(self, node_id: Any) -> None:
         if node_id in self._managed:
             self._managed.remove(node_id)
-        self._rt.remove_node(node_id)
+        self._terminate(node_id)
 
     def non_terminated_nodes(self) -> List[Any]:
         return [n for n in self._managed
                 if self._rt.nodes.get(n) and self._rt.nodes[n].alive]
+
+
+class VirtualNodeProvider(_RuntimeNodeProvider):
+    """Adds/removes virtual nodes on the in-process runtime — the
+    fake_multi_node provider analog for tests and laptops."""
+
+    def _create(self, node_config: Dict[str, Any]) -> Any:
+        return self._rt.add_node(dict(node_config))
+
+    def _terminate(self, node_id: Any) -> None:
+        self._rt.remove_node(node_id)
+
+
+class ProcessNodeProvider(_RuntimeNodeProvider):
+    """Scales real node-agent PROCESSES joined to the head over TCP (the
+    multi-host plane, core/node_agent.py) — each node shares nothing with
+    the head but the channel, so this is the faithful stand-in for a
+    cloud/TPU-pod provider on one box; a real pod provider implements the
+    same two methods with GCE create/delete calls."""
+
+    def _create(self, node_config: Dict[str, Any]) -> Any:
+        return self._rt.add_remote_node_process(
+            num_cpus=node_config.get("num_cpus", 4),
+            num_tpus=node_config.get("num_tpus", 0))
+
+    def _terminate(self, node_id: Any) -> None:
+        self._rt.stop_remote_node(node_id)
 
 
 class StandardAutoscaler:

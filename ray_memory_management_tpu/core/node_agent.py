@@ -197,6 +197,10 @@ class NodeAgent:
 
         while not self._stop.is_set():
             _time.sleep(1.0)
+            try:
+                self.store.sweep_pins()  # expire obj_ensure residency pins
+            except Exception:
+                pass
             with self._lock:
                 dead = [(wid, p) for wid, p in self._worker_procs.items()
                         if p.poll() is not None]
@@ -267,6 +271,18 @@ class NodeAgent:
         finally:
             self.store.release(oid)
 
+    def _obj_ensure(self, msg: dict) -> None:
+        """Restore the object into shm (if spilled) and pin it briefly so
+        the requesting worker's direct shm read cannot race a re-spill
+        (head-side _serve_get answers "local" only after this ack)."""
+        err = None
+        try:
+            if not self.store.ensure_resident(msg["oid"]):
+                err = "object not in store"
+        except Exception as e:
+            err = repr(e)
+        self._send({"type": "ensure_ack", "req": msg["req"], "error": err})
+
     # ------------------------------------------------------------------- main
     def run(self) -> None:
         try:
@@ -309,13 +325,21 @@ class NodeAgent:
                 self._obj_seal(msg)
             elif t == "obj_pull":
                 self._obj_pull(msg)
+            elif t == "obj_ensure":
+                self._obj_ensure(msg)
             elif t == "obj_free":
                 try:
                     self.store.delete(msg["oid"])
                 except Exception:
                     pass
             elif t == "ping":
-                self._send({"type": "pong"})
+                from ..utils import events as _events
+
+                evs = _events.drain_events(node_id=self.node_id.hex())
+                pong: Dict[str, Any] = {"type": "pong"}
+                if evs:
+                    pong["events"] = evs
+                self._send(pong)
             elif t == "shutdown":
                 return
 

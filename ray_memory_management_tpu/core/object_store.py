@@ -37,9 +37,14 @@ class NodeObjectStore:
         self.shm = ShmStore(name, capacity, create=create)
         self._spill_lock = threading.Lock()
         self._spilled: Dict[bytes, str] = {}  # object_id -> url
-        self._storage = ext.storage_for_uri(
-            self.config.object_store_fallback_directory
-        )
+        # ensure_resident pins: object_id -> (ref-holding view, expiry)
+        self._pinned: Dict[bytes, tuple] = {}
+        # scope the spill tier per store: several stores on one host (head +
+        # node agents) spill the SAME object ids (pushed copies) — in a
+        # shared directory one store's restore/delete would remove another
+        # store's spill file
+        base = self.config.object_store_fallback_directory.rstrip("/")
+        self._storage = ext.storage_for_uri(base + "/" + name.strip("/"))
         self._io = ThreadPoolExecutor(
             max_workers=self.config.max_io_workers,
             thread_name_prefix=f"io-{name.strip('/')}",
@@ -72,11 +77,26 @@ class NodeObjectStore:
             except ShmStoreFullError:
                 freed = self._spill_for(max(size, self.config.min_spilling_size))
                 if freed == 0:
+                    # ensure_resident pins are a read-race grace, not a
+                    # lease: under real pressure they must yield (readers
+                    # that miss re-request and re-ensure)
+                    if self._release_all_pins():
+                        continue
                     raise ObjectStoreFullError(
                         f"store {self.name}: cannot allocate {size} bytes; "
                         f"usage={self.shm.usage()}, nothing spillable"
                     )
         raise ObjectStoreFullError(f"store {self.name}: allocation retry limit")
+
+    def _release_all_pins(self) -> bool:
+        """Drop every ensure_resident pin; returns True if any was held."""
+        with self._spill_lock:
+            victims = list(self._pinned.items())
+            self._pinned.clear()
+        for oid, (view, _) in victims:
+            del view
+            self.shm.release(oid)
+        return bool(victims)
 
     def _spill_for(self, need_bytes: int) -> int:
         """Spill at least ``need_bytes`` of LRU unreferenced objects; returns
@@ -119,6 +139,39 @@ class NodeObjectStore:
                             source="object_store", bytes=freed,
                             objects=n_spilled)
             return freed
+
+    def ensure_resident(self, object_id: bytes,
+                        grace_s: float = 60.0) -> bool:
+        """Make the object shm-resident (restoring from spill if needed) and
+        pin it for ``grace_s`` so another process's direct shm read cannot
+        race a re-spill/eviction. The pin is a held refcount, released by
+        ``sweep_pins``. This is what lets the owner answer "local" to a
+        worker truthfully (the restore half of local_object_manager.h:111)."""
+        view = self.get(object_id)  # restores; takes a reader ref
+        if view is None:
+            return False
+        import time as _time
+
+        with self._spill_lock:
+            prev = self._pinned.pop(object_id, None)
+            self._pinned[object_id] = (view, _time.monotonic() + grace_s)
+        if prev is not None:
+            self.shm.release(object_id)  # drop the superseded pin's ref
+        return True
+
+    def sweep_pins(self) -> None:
+        """Release expired ensure_resident pins (called from the owner's
+        heartbeat loop / the agent's reap loop)."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._spill_lock:
+            expired = [oid for oid, (_, exp) in self._pinned.items()
+                       if exp <= now]
+            victims = [(oid, self._pinned.pop(oid)) for oid in expired]
+        for oid, (view, _) in victims:
+            del view
+            self.shm.release(oid)
 
     # -- read path ------------------------------------------------------------
     def get(self, object_id: bytes) -> Optional[memoryview]:

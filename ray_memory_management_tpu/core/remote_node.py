@@ -91,6 +91,11 @@ class RemoteStoreProxy:
     def release(self, object_id: bytes) -> None:
         pass  # pulled bytes are owned by the head-side caller
 
+    def ensure_resident(self, object_id: bytes) -> bool:
+        """Restore-and-pin on the agent so a remote worker's direct shm
+        read cannot race the agent's spill tier."""
+        return self._node.ensure_object(object_id)
+
     def delete(self, object_id: bytes) -> None:
         self._node.channel_send({"type": "obj_free", "oid": object_id})
 
@@ -225,14 +230,33 @@ class RemoteNodeManager(NodeManager):
                 self._pending.pop(req, None)
             return state["error"] is None
 
+    def ensure_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
+        """Ask the agent to make the object shm-resident (restoring from its
+        spill tier) and pin it briefly (node_agent obj_ensure)."""
+        if not self.alive:
+            return False
+        req = self._new_req()
+        with self._pending_lock:
+            state = self._pending.get(req)
+        if state is None or not self.channel_send(
+                {"type": "obj_ensure", "oid": object_id, "req": req}):
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return False
+        ok = state["event"].wait(timeout)
+        with self._pending_lock:
+            self._pending.pop(req, None)
+        return ok and state["error"] is None
+
     def on_channel_reply(self, msg: dict) -> None:
-        """push_ack / pull_data frames routed here by the runtime router."""
+        """push_ack / pull_data / ensure_ack frames routed here by the
+        runtime router."""
         req = msg.get("req")
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None:
             return
-        if msg["type"] == "push_ack":
+        if msg["type"] in ("push_ack", "ensure_ack"):
             state["error"] = msg.get("error")
             state["event"].set()
             return

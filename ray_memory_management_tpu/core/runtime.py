@@ -162,6 +162,7 @@ class Runtime:
         self._node_listener_thread = None
         self.node_listener_address: Optional[Tuple[str, int]] = None
         self._agent_procs: List[Any] = []  # agents spawned by this driver
+        self._agent_proc_by_node: Dict[NodeID, Any] = {}
         if config.enable_node_listener:
             from multiprocessing.connection import Listener as _TCPListener
 
@@ -397,7 +398,7 @@ class Runtime:
                 self._bind_remote_worker(nm, handle)
                 return
             self._handle_worker_message(handle, inner)
-        elif mtype in ("push_ack", "pull_data"):
+        elif mtype in ("push_ack", "pull_data", "ensure_ack"):
             nm.on_channel_reply(msg)
         elif mtype == "wdeath":
             handle = nm.worker_by_wid(msg["wid"])
@@ -406,7 +407,9 @@ class Runtime:
                     handle.proc.returncode = 1
                 self._on_worker_death(handle)
         elif mtype == "pong":
-            pass
+            # remote agents flush their structured-event buffer on the
+            # keepalive reply (node_agent.py ping handler)
+            events.ingest(msg.get("events") or [])
 
     def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
         from .remote_node import VirtualConn
@@ -477,14 +480,33 @@ class Runtime:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                new = [n for n in self.nodes if n not in before]
+                # match THIS child by its pid (registration carries the
+                # agent's pid): a concurrently-registering agent must not
+                # be attributed to our Popen handle
+                new = [n for n in self.nodes if n not in before
+                       and getattr(self.nodes[n], "agent_pid", None)
+                       == proc.pid]
             if new:
+                self._agent_proc_by_node[new[0]] = proc
                 return new[0]
             if proc.poll() is not None:
                 raise RuntimeError(
                     f"node agent exited rc={proc.returncode} before joining")
             time.sleep(0.05)
         raise TimeoutError("node agent did not register in time")
+
+    def stop_remote_node(self, node_id: NodeID) -> None:
+        """Gracefully retire an agent-process node: mark it dead in the
+        cluster (requeueing its work) and terminate the agent process —
+        the provider-side terminate half of the autoscaler contract."""
+        self.remove_node(node_id)
+        proc = self._agent_proc_by_node.pop(node_id, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+                proc.wait(timeout=5.0)
+            except Exception:
+                pass
 
     def _send(self, handle: WorkerHandle, msg: dict) -> bool:
         with self._lock:
@@ -1501,6 +1523,12 @@ class Runtime:
                         self.gcs.heartbeat(nm.node_id)
                 else:
                     self.gcs.heartbeat(nm.node_id)
+                    sweep = getattr(nm.store, "sweep_pins", None)
+                    if sweep is not None:
+                        try:
+                            sweep()  # expire ensure_resident pins
+                        except Exception:
+                            pass
             for node_id in self.gcs.check_heartbeats(timeout):
                 self.remove_node(node_id)
             self._stop.wait(interval)
@@ -1964,6 +1992,13 @@ class Runtime:
                         if not locs:
                             raise ObjectLostError(oid.hex())
                         self._transfer_object(oid, locs[0], node_id)
+            # answering "local" is a promise the worker's DIRECT shm read
+            # will hit: restore-from-spill and pin briefly (the worker's
+            # store client is shm-only and cannot see the spill tier)
+            ensure = getattr(nm.store, "ensure_resident", None)
+            if ensure is not None and not ensure(oid):
+                raise ObjectLostError(
+                    oid.hex(), "could not materialize on worker's node")
             values.append(("local", b""))
         return values
 

@@ -266,20 +266,33 @@ class WorkerRuntimeProxy:
                 out[oid] = self._worker.decode_value(view, pin=oid)
             else:
                 missing.append(oid)
-        if missing:
+        attempt = 0
+        while missing:
             reply = self._request(
                 {"type": "get_objects", "oids": missing}, timeout=timeout
             )
+            still: List[bytes] = []
             for oid, enc in zip(missing, reply["values"]):
                 if enc[0] == "v":
                     out[oid] = ser.loads(enc[1])
                 else:  # now present in the local store
                     view = self._worker.store.get(oid)
                     if view is None:
-                        raise RuntimeError(
-                            f"owner reported {oid.hex()} local but store miss"
-                        )
+                        # the owner's residency pin can be reclaimed under
+                        # store pressure before our read lands — re-request
+                        # (the owner restores again) instead of failing
+                        still.append(oid)
+                        continue
                     out[oid] = self._worker.decode_value(view, pin=oid)
+            missing = still
+            if missing:
+                attempt += 1
+                if attempt >= 4:
+                    raise RuntimeError(
+                        f"owner reported {missing[0].hex()} local but the "
+                        f"store read kept missing after {attempt} attempts"
+                    )
+                time.sleep(0.05 * attempt)
         return [out[oid] for oid in oids]
 
     def put_object(self, value: Any) -> bytes:

@@ -75,6 +75,28 @@ def emit(label: str, message: str, severity: str = INFO,
     return ev
 
 
+def drain_events(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Take and clear the local buffer, stamping ``node_id`` on events that
+    lack one — the remote-agent flush path (events ride the agent channel's
+    ping/pong keepalive to the head, like worker timeline spans ride task
+    replies)."""
+    with _lock:
+        evs = list(_events)
+        _events.clear()
+    if node_id is not None:
+        for ev in evs:
+            ev.setdefault("node_id", node_id)
+    return evs
+
+
+def ingest(evs: List[Dict[str, Any]]) -> None:
+    """Head-side: merge a batch of events shipped from another process."""
+    if not evs:
+        return
+    with _lock:
+        _events.extend(evs)
+
+
 def list_events(filters: Optional[Dict[str, Any]] = None,
                 limit: int = 10_000) -> List[Dict[str, Any]]:
     """Newest-last list of events, optionally filtered by exact match on

@@ -48,6 +48,49 @@ class TestEvents:
         retries = state.list_cluster_events({"label": "TASK_RETRY"})
         assert retries and retries[-1]["source"] == "core_worker"
 
+    def test_remote_agent_events_reach_head(self):
+        """Events emitted inside a node-agent PROCESS (e.g. its store
+        spilling) ride the ping/pong keepalive to the head's buffer."""
+        import time
+
+        import numpy as np
+
+        from ray_memory_management_tpu.core.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        rt = rmt.init(num_cpus=2, object_store_memory=32 << 20)
+        try:
+            remote_id = rt.add_remote_node_process(num_cpus=2)
+
+            @rmt.remote(max_retries=0)
+            def consume(arr):
+                return float(arr[0])
+
+            # put on the head, consume on the remote node: localization
+            # pushes 48 MB of args into the agent's 32 MB store, forcing
+            # agent-process spills (the push path allocates via the
+            # agent's NodeObjectStore -> _create_with_spill)
+            refs = [rmt.put(np.full(1 << 20, i, dtype=np.float64))
+                    for i in range(6)]  # 8 MB each
+            outs = [consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=remote_id, soft=False)).remote(r)
+                for i, r in enumerate(refs)]
+            assert rmt.get(outs, timeout=120) == [float(i)
+                                                  for i in range(6)]
+            deadline = time.time() + 15  # next keepalive flushes
+            spilled = []
+            while time.time() < deadline and not spilled:
+                spilled = [
+                    e for e in state.list_cluster_events(
+                        {"label": "OBJECT_SPILLED"})
+                    if e.get("node_id") == remote_id.hex()]
+                time.sleep(0.2)
+            assert spilled, "remote agent spill event never reached head"
+        finally:
+            rmt.shutdown()
+
     def test_sink_writes_jsonl(self, tmp_path):
         import json
 
@@ -70,6 +113,10 @@ class TestEvents:
 
 
 class TestProfiling:
+    @pytest.fixture(autouse=True)
+    def _need_jax(self):
+        pytest.importorskip("jax")
+
     def test_annotate_records_timeline_span(self):
         from ray_memory_management_tpu.utils import timeline
 

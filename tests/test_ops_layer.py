@@ -113,6 +113,41 @@ class TestAutoscaler:
         assert len(provider.non_terminated_nodes()) == 0
         assert autoscaler.num_terminations == 1
 
+    def test_process_provider_scales_real_agents(self, rmt_start_regular):
+        """ProcessNodeProvider: the autoscaler grows/shrinks with node-agent
+        PROCESSES over the multi-host plane, not in-process virtual nodes."""
+        from ray_memory_management_tpu.autoscaler import ProcessNodeProvider
+
+        rt = rmt_start_regular
+        provider = ProcessNodeProvider(rt)
+        autoscaler = StandardAutoscaler(
+            provider, node_config={"num_cpus": 4}, min_workers=0,
+            max_workers=1, idle_timeout_s=0.2, runtime=rt)
+
+        @rmt.remote(num_cpus=4)
+        def hog(t):
+            time.sleep(t)
+            return 1
+
+        refs = [hog.remote(2.0) for _ in range(3)]
+        time.sleep(0.3)
+        assert autoscaler.pending_demand() > 0
+        autoscaler.update()  # launches one agent process
+        assert autoscaler.num_launches == 1
+        (node_id,) = provider.non_terminated_nodes()
+        assert rt._agent_proc_by_node[node_id].poll() is None
+        assert rmt.get(refs, timeout=120) == [1] * 3
+        # drain, then idle-terminate the agent
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            autoscaler.update()
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.2)
+        assert not provider.non_terminated_nodes()
+        assert autoscaler.num_terminations == 1
+        assert not rt.nodes[node_id].alive
+
     def test_min_workers_maintained(self, rmt_start_regular):
         rt = rmt_start_regular
         provider = VirtualNodeProvider(rt)
