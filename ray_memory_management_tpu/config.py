@@ -36,6 +36,11 @@ _flag("min_spilling_size", int, 1 * 1024 * 1024,
 _flag("object_spilling_threshold", float, 0.8,
       "Start spilling when the store passes this fraction full "
       "(ray_config_def.h:499).")
+_flag("object_store_full_timeout_s", float, 5.0,
+      "How long an allocation waits for reader refs / pins to drain when "
+      "nothing is spillable before raising ObjectStoreFullError (the plasma "
+      "CreateRequestQueue blocks clients the same way, "
+      "create_request_queue.h:32).")
 _flag("max_io_workers", int, 2,
       "Concurrent spill/restore IO threads (ray_config_def.h:489; default 4).")
 _flag("object_manager_chunk_size", int, 5 * 1024 * 1024,

@@ -46,6 +46,7 @@ import socket
 import subprocess
 import sys
 import threading
+from collections import deque
 from typing import Any, Dict, Optional
 
 from ..config import Config
@@ -118,6 +119,27 @@ class NodeAgent:
         self._worker_send_locks: Dict[bytes, threading.Lock] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # The object plane runs on its OWN thread: a push/ensure into a
+        # full store waits (bounded) for capacity, and that wait must never
+        # starve liveness pings, task dispatch (wsend), or the obj_free
+        # frames that drain capacity. FIFO per-frame ordering within the
+        # plane (push -> chunk -> seal) is preserved by the single queue.
+        self._obj_q: deque = deque()
+        self._obj_q_bytes = 0  # payload bytes queued (chunk frames)
+        # cap on queued payload so a blocked store never buffers an entire
+        # multi-GB transfer backlog in agent RAM: past it the recv loop
+        # parks, which stops draining the socket and pushes the pressure
+        # back to TCP (the reference's PullManager caps in-flight bytes the
+        # same way, pull_manager.h:47)
+        self._obj_q_limit = max(64 << 20,
+                                4 * self.config.object_manager_chunk_size)
+        self._obj_cond = threading.Condition()
+        # frees that arrived while a push of the same object was still
+        # queued/mid-flight: consumed by _obj_push/_obj_seal so the freed
+        # object is not resurrected by the late-landing push
+        self._freed_while_pushing: set = set()
+        threading.Thread(target=self._obj_plane_loop, daemon=True,
+                         name="agent-objplane").start()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="agent-accept").start()
         threading.Thread(target=self._reap_loop, daemon=True,
@@ -217,12 +239,16 @@ class NodeAgent:
     # ----------------------------------------------------------- object plane
     def _obj_push(self, msg: dict) -> None:
         oid = msg["oid"]
+        if oid in self._freed_while_pushing:
+            return  # freed before this push landed: don't resurrect it
         if oid in self._push_bufs:
             return  # an identical push is mid-flight; let it finish
         try:
             self._push_bufs[oid] = self.store.create(oid, msg["size"])
         except ValueError:
             pass  # already sealed in the store: ignore this push's chunks
+        except Exception:  # noqa: BLE001 — store full even after waiting:
+            pass  # drop the chunks; _obj_seal acks the push with an error
 
     def _obj_chunk(self, msg: dict) -> None:
         buf = self._push_bufs.get(msg["oid"])
@@ -234,6 +260,21 @@ class NodeAgent:
     def _obj_seal(self, msg: dict) -> None:
         oid = msg["oid"]
         err = None
+        if oid in self._freed_while_pushing:
+            # the head freed this object while its push was still in our
+            # queue: drop the landed bytes instead of resurrecting it
+            self._freed_while_pushing.discard(oid)
+            buf = self._push_bufs.pop(oid, None)
+            if buf is not None:
+                del buf
+                try:
+                    self.store.seal(oid)  # must seal before delete
+                    self.store.delete(oid)
+                except Exception:
+                    pass
+            self._send({"type": "push_ack", "req": msg["req"],
+                        "error": "object freed during push"})
+            return
         if oid in self._push_bufs:
             del self._push_bufs[oid]
             try:
@@ -248,15 +289,22 @@ class NodeAgent:
 
     def _obj_pull(self, msg: dict) -> None:
         oid, req = msg["oid"], msg["req"]
-        view = self.store.get(oid)
+        try:
+            # read() serves spilled objects straight from the spill file —
+            # a pull must never force an allocation in a full store
+            view = self.store.read(oid)
+        except Exception as e:  # noqa: BLE001
+            view = None
+            err = repr(e)
+        else:
+            err = "object not in store"
         if view is None:
             self._send({"type": "pull_data", "req": req, "off": 0,
-                        "data": b"", "eof": True,
-                        "error": "object not in store"})
+                        "data": b"", "eof": True, "error": err})
             return
         try:
             chunk = self.config.object_manager_chunk_size
-            n = view.nbytes
+            n = len(view) if isinstance(view, bytes) else view.nbytes
             if n == 0:
                 self._send({"type": "pull_data", "req": req, "off": 0,
                             "data": b"", "eof": True, "error": None})
@@ -269,7 +317,8 @@ class NodeAgent:
                     "error": None,
                 })
         finally:
-            self.store.release(oid)
+            if isinstance(view, memoryview):
+                self.store.release(oid)
 
     def _obj_ensure(self, msg: dict) -> None:
         """Restore the object into shm (if spilled) and pin it briefly so
@@ -282,6 +331,29 @@ class NodeAgent:
         except Exception as e:
             err = repr(e)
         self._send({"type": "ensure_ack", "req": msg["req"], "error": err})
+
+    def _obj_plane_loop(self) -> None:
+        handlers = {
+            "obj_push": self._obj_push,
+            "obj_chunk": self._obj_chunk,
+            "obj_seal": self._obj_seal,
+            "obj_pull": self._obj_pull,
+            "obj_ensure": self._obj_ensure,
+        }
+        while not self._stop.is_set():
+            with self._obj_cond:
+                while not self._obj_q:
+                    self._obj_cond.wait(timeout=1.0)
+                    if self._stop.is_set():
+                        return
+                msg = self._obj_q.popleft()
+                if msg["type"] == "obj_chunk":
+                    self._obj_q_bytes -= len(msg["data"])
+                    self._obj_cond.notify_all()  # recv loop may be parked
+            try:
+                handlers[msg["type"]](msg)
+            except Exception:  # noqa: BLE001 — one bad frame must not
+                pass  # take down the whole object plane
 
     # ------------------------------------------------------------------- main
     def run(self) -> None:
@@ -317,19 +389,30 @@ class NodeAgent:
                         proc.terminate()
                     except Exception:
                         pass
-            elif t == "obj_push":
-                self._obj_push(msg)
-            elif t == "obj_chunk":
-                self._obj_chunk(msg)
-            elif t == "obj_seal":
-                self._obj_seal(msg)
-            elif t == "obj_pull":
-                self._obj_pull(msg)
-            elif t == "obj_ensure":
-                self._obj_ensure(msg)
+            elif t in ("obj_push", "obj_chunk", "obj_seal", "obj_pull",
+                       "obj_ensure"):
+                nbytes = len(msg["data"]) if t == "obj_chunk" else 0
+                with self._obj_cond:
+                    # backpressure: park (stop reading the socket) rather
+                    # than buffer an unbounded backlog in agent memory
+                    while (self._obj_q_bytes > self._obj_q_limit
+                           and not self._stop.is_set()):
+                        self._obj_cond.wait(timeout=1.0)
+                    self._obj_q.append(msg)
+                    self._obj_q_bytes += nbytes
+                    self._obj_cond.notify()
             elif t == "obj_free":
+                oid = msg["oid"]
                 try:
-                    self.store.delete(msg["oid"])
+                    if self.store.contains(oid):
+                        self.store.delete(oid)
+                    else:
+                        # a push of this object may still be queued on the
+                        # object plane; mark it so the late-landing push
+                        # does not resurrect a freed object
+                        if len(self._freed_while_pushing) > 4096:
+                            self._freed_while_pushing.clear()  # stale
+                        self._freed_while_pushing.add(oid)
                 except Exception:
                     pass
             elif t == "ping":

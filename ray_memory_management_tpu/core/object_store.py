@@ -11,11 +11,18 @@ Combines three reference components into the TPU-host store model:
   - the owner-side in-process memory store for small objects
     (src/ray/core_worker/store_provider/memory_store/memory_store.h:43) lives
     in the driver/worker runtime, not here.
+
+Allocation under pressure WAITS (bounded) instead of failing: capacity held
+by in-flight reader refs (executing tasks) or residency pins drains within
+milliseconds, and failing immediately turns a transient full store into a
+spurious ObjectLostError — the reference's plasma CreateRequestQueue blocks
+clients the same way (src/ray/object_manager/plasma/create_request_queue.h:32).
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -36,6 +43,12 @@ class NodeObjectStore:
         capacity = self.config.object_store_memory
         self.shm = ShmStore(name, capacity, create=create)
         self._spill_lock = threading.Lock()
+        # per-object restore claims: oid -> Event set when the restore ends.
+        # A dict (not one big lock) so restores of DIFFERENT objects run
+        # concurrently and a restore parked in the allocation wait never
+        # stalls an unrelated get()
+        self._restore_mu = threading.Lock()
+        self._restoring: Dict[bytes, threading.Event] = {}
         self._spilled: Dict[bytes, str] = {}  # object_id -> url
         # ensure_resident pins: object_id -> (ref-holding view, expiry)
         self._pinned: Dict[bytes, tuple] = {}
@@ -70,23 +83,34 @@ class NodeObjectStore:
     def _create_with_spill(self, object_id: bytes, size: int) -> memoryview:
         """Allocate, spilling LRU objects on pressure — the CreateRequestQueue
         + spill fallback path (plasma create_request_queue.h:32 +
-        local_object_manager.h:99)."""
-        for _ in range(16):
+        local_object_manager.h:99). When nothing is spillable (capacity held
+        by executing tasks' reader refs), waits up to
+        ``object_store_full_timeout_s`` for refs to drain rather than failing
+        a transiently-full store."""
+        timeout_s = self.config.object_store_full_timeout_s
+        deadline = time.monotonic() + timeout_s
+        # residency pins are a read-race grace, not a lease: under sustained
+        # pressure they yield (readers that miss re-request and re-ensure),
+        # but only after a short delay so promised reads usually land first
+        # (never later than half the full-store budget, so short timeouts
+        # still get the pin-break before they expire)
+        pin_break_at = time.monotonic() + min(0.5, timeout_s / 2)
+        while True:
             try:
                 return self.shm.create(object_id, size)
             except ShmStoreFullError:
-                freed = self._spill_for(max(size, self.config.min_spilling_size))
-                if freed == 0:
-                    # ensure_resident pins are a read-race grace, not a
-                    # lease: under real pressure they must yield (readers
-                    # that miss re-request and re-ensure)
-                    if self._release_all_pins():
-                        continue
-                    raise ObjectStoreFullError(
-                        f"store {self.name}: cannot allocate {size} bytes; "
-                        f"usage={self.shm.usage()}, nothing spillable"
-                    )
-        raise ObjectStoreFullError(f"store {self.name}: allocation retry limit")
+                pass
+            if time.monotonic() >= deadline:
+                raise ObjectStoreFullError(
+                    f"store {self.name}: cannot allocate {size} bytes within "
+                    f"{self.config.object_store_full_timeout_s:.1f}s; "
+                    f"usage={self.shm.usage()}"
+                )
+            if self._spill_for(max(size, self.config.min_spilling_size)):
+                continue
+            if time.monotonic() >= pin_break_at and self._release_all_pins():
+                continue
+            time.sleep(0.02)
 
     def _release_all_pins(self) -> bool:
         """Drop every ensure_resident pin; returns True if any was held."""
@@ -150,11 +174,9 @@ class NodeObjectStore:
         view = self.get(object_id)  # restores; takes a reader ref
         if view is None:
             return False
-        import time as _time
-
         with self._spill_lock:
             prev = self._pinned.pop(object_id, None)
-            self._pinned[object_id] = (view, _time.monotonic() + grace_s)
+            self._pinned[object_id] = (view, time.monotonic() + grace_s)
         if prev is not None:
             self.shm.release(object_id)  # drop the superseded pin's ref
         return True
@@ -162,9 +184,7 @@ class NodeObjectStore:
     def sweep_pins(self) -> None:
         """Release expired ensure_resident pins (called from the owner's
         heartbeat loop / the agent's reap loop)."""
-        import time as _time
-
-        now = _time.monotonic()
+        now = time.monotonic()
         with self._spill_lock:
             expired = [oid for oid, (_, exp) in self._pinned.items()
                        if exp <= now]
@@ -176,24 +196,93 @@ class NodeObjectStore:
     # -- read path ------------------------------------------------------------
     def get(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy view, restoring from spill if needed. None if absent."""
-        view = self.shm.get(object_id)
-        if view is not None:
-            return view
-        url = self._spilled.get(object_id)
+        for _ in range(4):
+            view = self.shm.get(object_id)
+            if view is not None:
+                return view
+            with self._restore_mu:
+                ev = self._restoring.get(object_id)
+            if ev is not None:
+                # another thread is restoring this object: wait it out,
+                # then re-check shm (loop)
+                ev.wait(self.config.object_store_full_timeout_s + 5.0)
+                continue
+            with self._spill_lock:
+                spilled = object_id in self._spilled
+            if not spilled:
+                # a restore may have completed between our shm miss and the
+                # spill-record check (moving the object file -> shm): the
+                # re-check is what makes a hit authoritative; a second miss
+                # with no spill copy and no in-flight restore means absent
+                return self.shm.get(object_id)
+            with self._restore_mu:
+                ev = self._restoring.get(object_id)
+                owner = ev is None
+                if owner:
+                    ev = self._restoring[object_id] = threading.Event()
+            if not owner:
+                ev.wait(self.config.object_store_full_timeout_s + 5.0)
+                continue
+            try:
+                return self._restore_into_shm(object_id)
+            finally:
+                with self._restore_mu:
+                    self._restoring.pop(object_id, None)
+                ev.set()
+        return None
+
+    def _restore_into_shm(self, object_id: bytes) -> Optional[memoryview]:
+        """Move one spilled object back into shm; returns a referenced view
+        (or None if it was deleted concurrently). Caller holds the
+        _restoring claim for this object."""
+        with self._spill_lock:
+            url = self._spilled.get(object_id)
         if url is None:
-            return None
-        data = self._storage.restore(object_id, url)
+            return self.shm.get(object_id)
+        try:
+            data = self._storage.restore(object_id, url)
+        except OSError:
+            return None  # concurrently delete()d
         try:
             buf = self._create_with_spill(object_id, len(data))
         except ValueError:
-            # someone restored it concurrently
+            # a pushed copy landed concurrently
             return self.shm.get(object_id)
         buf[:] = data
-        self.shm.seal(object_id)
+        del buf
+        # seal, take the reader ref, and drop the spill record under
+        # _spill_lock: a concurrent _spill_for must never see the fresh
+        # object sealed-with-zero-refs (it would evict it and the pop
+        # below would erase the NEW spill record — losing the object)
         with self._spill_lock:
+            self.shm.seal(object_id)
+            out = self.shm.get(object_id)
             self._spilled.pop(object_id, None)
+        # synchronous: a delete queued on the _io pool would be dropped by
+        # close()'s shutdown(wait=False), orphaning the spill file
         self._storage.delete(url)
-        return self.shm.get(object_id)
+        return out
+
+    def read(self, object_id: bytes):
+        """A readable buffer of the object WITHOUT forcing shm residency:
+        the shm view when resident (caller must ``release``), the spill
+        file's bytes when spilled. Serving a transfer or an inline get must
+        never require allocating in a full store — the reference's object
+        manager reads spilled objects straight from external storage too
+        (local_object_manager.h:180)."""
+        for _ in range(2):  # retry once: a concurrent restore moves the
+            view = self.shm.get(object_id)  # object spill-file -> shm
+            if view is not None:
+                return view
+            with self._spill_lock:
+                url = self._spilled.get(object_id)
+            if url is None:
+                continue
+            try:
+                return self._storage.restore(object_id, url)
+            except OSError:
+                continue  # restored or delete()d concurrently
+        return None
 
     def contains(self, object_id: bytes) -> bool:
         return self.shm.contains(object_id) or object_id in self._spilled
@@ -204,6 +293,11 @@ class NodeObjectStore:
     def delete(self, object_id: bytes) -> None:
         with self._spill_lock:
             url = self._spilled.pop(object_id, None)
+            pin = self._pinned.pop(object_id, None)
+        if pin is not None:
+            view, _ = pin
+            del view
+            self.shm.release(object_id)
         if url:
             self._storage.delete(url)
         self.shm.delete(object_id)

@@ -35,6 +35,7 @@ from ..exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
     TaskError,
     WorkerCrashedError,
 )
@@ -1878,7 +1879,8 @@ class Runtime:
             elif mtype == "create_actor":
                 reply["actor_id"] = self.create_actor(msg["payload"])
             elif mtype == "get_objects":
-                reply["values"] = self._serve_get(handle, msg["oids"])
+                reply["values"] = self._serve_get(
+                    handle, msg["oids"], inline=msg.get("inline", False))
             elif mtype == "put_inline":
                 oid = ObjectID.for_put().binary()
                 with self._lock:
@@ -1954,10 +1956,14 @@ class Runtime:
         if not self._send(handle, reply):
             self._on_worker_death(handle)
 
-    def _serve_get(self, handle: WorkerHandle, oids: List[bytes]):
+    def _serve_get(self, handle: WorkerHandle, oids: List[bytes],
+                   inline: bool = False):
         """Make each object available to the requesting worker: inline bytes
         for memory-store values, or ensure presence in the worker's node store
-        (transfer / spill-restore / lineage recovery)."""
+        (transfer / spill-restore / lineage recovery). With ``inline`` the
+        envelope bytes are sent back in the reply even for store objects —
+        the worker's last-resort path when its direct shm reads keep losing
+        the race against the store's spill tier."""
         values = []
         for oid in oids:
             with self._lock:
@@ -1971,6 +1977,25 @@ class Runtime:
                 continue
             node_id = handle.node_id
             nm = self.nodes[node_id]
+            if inline:
+                # inline serve needs NO copy on the worker's (possibly full)
+                # node: read the bytes from whatever live node has them
+                data = self._inline_bytes_anywhere(oid, prefer=node_id)
+                if data is None:
+                    self._ensure_device_materialized(oid)
+                    data = self._inline_bytes_anywhere(oid, prefer=node_id)
+                if data is None:
+                    self._recover_object(oid)
+                    with self._lock:
+                        data = self.memory_store.get(oid)
+                    if data is None:
+                        data = self._inline_bytes_anywhere(oid,
+                                                           prefer=node_id)
+                if data is None:
+                    raise ObjectLostError(
+                        oid.hex(), "could not materialize on worker's node")
+                values.append(("v", data))
+                continue
             if not nm.store.contains(oid):
                 self._ensure_device_materialized(oid)
                 locs = [l for l in self.gcs.get_object_locations(oid)
@@ -1996,11 +2021,54 @@ class Runtime:
             # will hit: restore-from-spill and pin briefly (the worker's
             # store client is shm-only and cannot see the spill tier)
             ensure = getattr(nm.store, "ensure_resident", None)
-            if ensure is not None and not ensure(oid):
-                raise ObjectLostError(
-                    oid.hex(), "could not materialize on worker's node")
+            ensured = False
+            if ensure is not None:
+                try:
+                    ensured = ensure(oid)
+                except ObjectStoreFullError:
+                    ensured = False  # transiently full: serve inline below
+            if ensure is not None and not ensured:
+                # the node's store is too full to restore (capacity held by
+                # executing tasks): serve the bytes inline as a last resort
+                # before declaring the object lost
+                data = self._inline_bytes_anywhere(oid, prefer=node_id)
+                if data is None:
+                    raise ObjectLostError(
+                        oid.hex(), "could not materialize on worker's node")
+                values.append(("v", data))
+                continue
             values.append(("local", b""))
         return values
+
+    def _inline_bytes_anywhere(self, oid: bytes,
+                               prefer: NodeID) -> Optional[bytes]:
+        """Envelope bytes from ANY live node holding the object, trying
+        ``prefer`` first — no transfer into (and no allocation on) the
+        requesting worker's node."""
+        order = [prefer] + [l for l in self.gcs.get_object_locations(oid)
+                            if l != prefer]
+        for node_id in order:
+            nm = self.nodes.get(node_id)
+            if nm is None or not nm.alive:
+                continue
+            data = self._inline_bytes_from_store(nm, oid)
+            if data is not None:
+                return data
+        return None
+
+    def _inline_bytes_from_store(self, nm, oid: bytes) -> Optional[bytes]:
+        """Envelope bytes from a node's store without forcing shm residency
+        (NodeObjectStore.read serves spilled objects from the spill file;
+        the remote proxy's get pulls over the channel, which the agent also
+        serves residency-free)."""
+        reader = getattr(nm.store, "read", None) or nm.store.get
+        view = reader(oid)
+        if view is None:
+            return None
+        data = bytes(view)
+        if isinstance(view, memoryview):
+            nm.store.release(oid)
+        return data
 
     # ---------------------------------------------------------------- cancel
     def cancel(self, oid: bytes, force: bool = False) -> None:

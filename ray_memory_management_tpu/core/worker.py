@@ -268,9 +268,13 @@ class WorkerRuntimeProxy:
                 missing.append(oid)
         attempt = 0
         while missing:
-            reply = self._request(
-                {"type": "get_objects", "oids": missing}, timeout=timeout
-            )
+            req = {"type": "get_objects", "oids": missing}
+            if attempt >= 3:
+                # the owner's residency promise keeps getting reclaimed
+                # under store pressure: ask for the bytes inline instead of
+                # racing the spill tier again
+                req["inline"] = True
+            reply = self._request(req, timeout=timeout)
             still: List[bytes] = []
             for oid, enc in zip(missing, reply["values"]):
                 if enc[0] == "v":
@@ -287,7 +291,7 @@ class WorkerRuntimeProxy:
             missing = still
             if missing:
                 attempt += 1
-                if attempt >= 4:
+                if attempt >= 8:
                     raise RuntimeError(
                         f"owner reported {missing[0].hex()} local but the "
                         f"store read kept missing after {attempt} attempts"

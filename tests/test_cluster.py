@@ -106,6 +106,92 @@ def test_spilling_and_restore(rmt_small_store):
         del v
 
 
+def test_concurrent_restore_spill_churn():
+    """Regression: a restore's seal and a concurrent spill pass used to race
+    — the spiller could evict the freshly-restored object and the restorer
+    then erased the NEW spill record, losing the object entirely. Hammer
+    restore/spill/ensure from many threads and assert nothing is ever lost."""
+    import threading
+
+    from ray_memory_management_tpu.config import Config
+    from ray_memory_management_tpu.core.object_store import NodeObjectStore
+
+    cfg = Config(object_store_memory=32 << 20,
+                 object_store_full_timeout_s=15.0)
+    store = NodeObjectStore(f"/rmt_churn_{os.getpid()}", cfg, create=True)
+    try:
+        blobs = {bytes([i]) * 16: bytes([i]) * (4 << 20) for i in range(12)}
+        for oid, data in blobs.items():
+            store.put_bytes(oid, data)  # 48 MB into 32 MB: spills
+
+        errors = []
+
+        def churn(seed):
+            oids = list(blobs)
+            try:
+                for k in range(40):
+                    oid = oids[(seed + k) % len(oids)]
+                    if not store.ensure_resident(oid):
+                        errors.append(f"lost {oid.hex()}")
+                        return
+                    view = store.get(oid)
+                    if view is None:
+                        errors.append(f"get miss {oid.hex()}")
+                        return
+                    ok = bytes(view[:8]) == blobs[oid][:8]
+                    del view
+                    store.release(oid)
+                    if not ok:
+                        errors.append(f"corrupt {oid.hex()}")
+                        return
+            except Exception as e:  # noqa: BLE001 — a thread death must
+                errors.append(f"raised {e!r}")  # fail the test, not vanish
+
+        threads = [threading.Thread(target=churn, args=(s,)) for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for oid in blobs:
+            assert store.contains(oid), f"{oid.hex()} vanished"
+    finally:
+        store.close(unlink=True)
+
+
+def test_push_under_pressure_remote_node():
+    """Regression for the round-2 failing path: args exceeding the remote
+    agent's store force spills while tasks hold reader refs; allocation must
+    wait for refs to drain (and fall back to inline serves) instead of
+    surfacing ObjectLostError."""
+    from ray_memory_management_tpu.config import Config
+    from ray_memory_management_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cfg = Config(object_store_memory=32 << 20)
+    rt = rmt.init(num_cpus=2, _config=cfg)
+    try:
+        remote_id = rt.add_remote_node_process(num_cpus=2)
+
+        @rmt.remote(max_retries=0)
+        def consume(arr):
+            import time as _t
+
+            _t.sleep(0.1)  # hold the arg's reader ref under pressure
+            return float(arr[0])
+
+        refs = [rmt.put(np.full(1 << 20, i, dtype=np.float64))
+                for i in range(8)]  # 64 MB of args into a 32 MB agent store
+        outs = [consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=remote_id, soft=False)).remote(r)
+            for r in refs]
+        assert rmt.get(outs, timeout=180) == [float(i) for i in range(8)]
+    finally:
+        rmt.shutdown()
+
+
 def test_custom_resources():
     rt = rmt.init(num_cpus=4, resources={"widget": 2})
     try:
