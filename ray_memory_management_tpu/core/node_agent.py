@@ -136,8 +136,12 @@ class NodeAgent:
         self._obj_cond = threading.Condition()
         # frees that arrived while a push of the same object was still
         # queued/mid-flight: consumed by _obj_push/_obj_seal so the freed
-        # object is not resurrected by the late-landing push
-        self._freed_while_pushing: set = set()
+        # object is not resurrected by the late-landing push. _free_mu makes
+        # the free's contains-or-mark and the seal's mark-or-seal decisions
+        # atomic against each other (recv thread vs object-plane thread);
+        # dict (insertion-ordered) so overflow evicts the STALEST marker
+        self._freed_while_pushing: Dict[bytes, bool] = {}
+        self._free_mu = threading.Lock()
         threading.Thread(target=self._obj_plane_loop, daemon=True,
                          name="agent-objplane").start()
         threading.Thread(target=self._accept_loop, daemon=True,
@@ -260,10 +264,24 @@ class NodeAgent:
     def _obj_seal(self, msg: dict) -> None:
         oid = msg["oid"]
         err = None
-        if oid in self._freed_while_pushing:
-            # the head freed this object while its push was still in our
-            # queue: drop the landed bytes instead of resurrecting it
-            self._freed_while_pushing.discard(oid)
+        # the mark-or-seal decision is atomic against the recv thread's
+        # contains-or-mark in obj_free: without the mutex a free landing
+        # between our marker check and store.seal() would resurrect the
+        # freed object with no future delete ever coming
+        with self._free_mu:
+            freed = self._freed_while_pushing.pop(oid, None) is not None
+            if not freed and oid in self._push_bufs:
+                del self._push_bufs[oid]
+                try:
+                    self.store.seal(oid)
+                except Exception as e:  # noqa: BLE001
+                    err = repr(e)
+            elif not freed and not self.store.contains(oid):
+                # this push's create was refused and nobody else sealed it:
+                # acking success would poison the head's object directory
+                err = "push raced an incomplete object"
+        if freed:
+            # drop the landed bytes instead of resurrecting a freed object
             buf = self._push_bufs.pop(oid, None)
             if buf is not None:
                 del buf
@@ -272,19 +290,7 @@ class NodeAgent:
                     self.store.delete(oid)
                 except Exception:
                     pass
-            self._send({"type": "push_ack", "req": msg["req"],
-                        "error": "object freed during push"})
-            return
-        if oid in self._push_bufs:
-            del self._push_bufs[oid]
-            try:
-                self.store.seal(oid)
-            except Exception as e:  # noqa: BLE001
-                err = repr(e)
-        elif not self.store.contains(oid):
-            # this push's create was refused and nobody else sealed it:
-            # acking success would poison the head's object directory
-            err = "push raced an incomplete object"
+            err = "object freed during push"
         self._send({"type": "push_ack", "req": msg["req"], "error": err})
 
     def _obj_pull(self, msg: dict) -> None:
@@ -321,16 +327,22 @@ class NodeAgent:
                 self.store.release(oid)
 
     def _obj_ensure(self, msg: dict) -> None:
-        """Restore the object into shm (if spilled) and pin it briefly so
+        """Restore the object(s) into shm (if spilled) and pin briefly so
         the requesting worker's direct shm read cannot race a re-spill
-        (head-side _serve_get answers "local" only after this ack)."""
-        err = None
-        try:
-            if not self.store.ensure_resident(msg["oid"]):
-                err = "object not in store"
-        except Exception as e:
-            err = repr(e)
-        self._send({"type": "ensure_ack", "req": msg["req"], "error": err})
+        (head-side _serve_get answers "local" only after this ack). Accepts
+        a batch ("oids") — one frame + one ack for a whole get request."""
+        oids = msg.get("oids")
+        if oids is None:
+            oids = [msg["oid"]]
+        failed = []
+        for oid in oids:
+            try:
+                if not self.store.ensure_resident(oid):
+                    failed.append(oid)
+            except Exception:  # noqa: BLE001 — full store etc: per-oid fail
+                failed.append(oid)
+        self._send({"type": "ensure_ack", "req": msg["req"], "error": None,
+                    "failed": failed})
 
     def _obj_plane_loop(self) -> None:
         handlers = {
@@ -404,15 +416,17 @@ class NodeAgent:
             elif t == "obj_free":
                 oid = msg["oid"]
                 try:
-                    if self.store.contains(oid):
-                        self.store.delete(oid)
-                    else:
-                        # a push of this object may still be queued on the
-                        # object plane; mark it so the late-landing push
-                        # does not resurrect a freed object
-                        if len(self._freed_while_pushing) > 4096:
-                            self._freed_while_pushing.clear()  # stale
-                        self._freed_while_pushing.add(oid)
+                    with self._free_mu:
+                        if self.store.contains(oid):
+                            self.store.delete(oid)
+                        else:
+                            # a push of this object may still be queued on
+                            # the object plane; mark it so the late-landing
+                            # push does not resurrect a freed object
+                            while len(self._freed_while_pushing) > 4096:
+                                self._freed_while_pushing.pop(
+                                    next(iter(self._freed_while_pushing)))
+                            self._freed_while_pushing[oid] = True
                 except Exception:
                     pass
             elif t == "ping":
@@ -422,7 +436,12 @@ class NodeAgent:
                 pong: Dict[str, Any] = {"type": "pong"}
                 if evs:
                     pong["events"] = evs
-                self._send(pong)
+                try:
+                    self._send(pong)
+                except (OSError, BrokenPipeError):
+                    if evs:
+                        _events.ingest(evs)  # retry on next ping
+                    return
             elif t == "shutdown":
                 return
 

@@ -96,6 +96,12 @@ class RemoteStoreProxy:
         read cannot race the agent's spill tier."""
         return self._node.ensure_object(object_id)
 
+    def ensure_resident_many(self, object_ids) -> Dict[bytes, bool]:
+        """Batched restore-and-pin: ONE channel round-trip for N objects
+        (a per-object ensure against a degraded agent would serialize N
+        blocking waits on the caller's thread)."""
+        return self._node.ensure_objects(list(object_ids))
+
     def delete(self, object_id: bytes) -> None:
         self._node.channel_send({"type": "obj_free", "oid": object_id})
 
@@ -233,20 +239,30 @@ class RemoteNodeManager(NodeManager):
     def ensure_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
         """Ask the agent to make the object shm-resident (restoring from its
         spill tier) and pin it briefly (node_agent obj_ensure)."""
-        if not self.alive:
-            return False
+        res = self.ensure_objects([object_id], timeout=timeout)
+        return res.get(object_id, False)
+
+    def ensure_objects(self, object_ids, timeout: float = 60.0
+                       ) -> Dict[bytes, bool]:
+        """Batched obj_ensure: one frame + one ack for N objects."""
+        if not self.alive or not object_ids:
+            return {oid: False for oid in object_ids}
         req = self._new_req()
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None or not self.channel_send(
-                {"type": "obj_ensure", "oid": object_id, "req": req}):
+                {"type": "obj_ensure", "oids": list(object_ids),
+                 "req": req}):
             with self._pending_lock:
                 self._pending.pop(req, None)
-            return False
+            return {oid: False for oid in object_ids}
         ok = state["event"].wait(timeout)
         with self._pending_lock:
             self._pending.pop(req, None)
-        return ok and state["error"] is None
+        if not ok or state["error"] is not None:
+            return {oid: False for oid in object_ids}
+        failed = set(state.get("failed") or ())
+        return {oid: oid not in failed for oid in object_ids}
 
     def on_channel_reply(self, msg: dict) -> None:
         """push_ack / pull_data / ensure_ack frames routed here by the
@@ -258,6 +274,7 @@ class RemoteNodeManager(NodeManager):
             return
         if msg["type"] in ("push_ack", "ensure_ack"):
             state["error"] = msg.get("error")
+            state["failed"] = msg.get("failed")
             state["event"].set()
             return
         if msg.get("error"):

@@ -1964,8 +1964,11 @@ class Runtime:
         envelope bytes are sent back in the reply even for store objects —
         the worker's last-resort path when its direct shm reads keep losing
         the race against the store's spill tier."""
-        values = []
-        for oid in oids:
+        values: Dict[bytes, tuple] = {}
+        need_ensure: List[bytes] = []
+        node_id = handle.node_id
+        nm = self.nodes[node_id]
+        for oid in dict.fromkeys(oids):
             with self._lock:
                 fut = self.futures.get(oid)
             if fut is not None and not fut.done():
@@ -1973,10 +1976,8 @@ class Runtime:
             with self._lock:
                 data = self.memory_store.get(oid)
             if data is not None:
-                values.append(("v", data))
+                values[oid] = ("v", data)
                 continue
-            node_id = handle.node_id
-            nm = self.nodes[node_id]
             if inline:
                 # inline serve needs NO copy on the worker's (possibly full)
                 # node: read the bytes from whatever live node has them
@@ -1994,40 +1995,54 @@ class Runtime:
                 if data is None:
                     raise ObjectLostError(
                         oid.hex(), "could not materialize on worker's node")
-                values.append(("v", data))
+                values[oid] = ("v", data)
                 continue
             if not nm.store.contains(oid):
-                self._ensure_device_materialized(oid)
-                locs = [l for l in self.gcs.get_object_locations(oid)
-                        if l != node_id and self.nodes.get(l)
-                        and self.nodes[l].alive]
-                if locs:
-                    self._transfer_object(oid, locs[0], node_id)
-                elif not nm.store.contains(oid):
-                    self._recover_object(oid)
-                    # recovery may produce an inline value
-                    with self._lock:
-                        data = self.memory_store.get(oid)
-                    if data is not None:
-                        values.append(("v", data))
-                        continue
-                    if not nm.store.contains(oid):
-                        locs = [l for l in self.gcs.get_object_locations(oid)
-                                if self.nodes.get(l) and self.nodes[l].alive]
-                        if not locs:
-                            raise ObjectLostError(oid.hex())
-                        self._transfer_object(oid, locs[0], node_id)
-            # answering "local" is a promise the worker's DIRECT shm read
-            # will hit: restore-from-spill and pin briefly (the worker's
-            # store client is shm-only and cannot see the spill tier)
-            ensure = getattr(nm.store, "ensure_resident", None)
-            ensured = False
-            if ensure is not None:
                 try:
-                    ensured = ensure(oid)
-                except ObjectStoreFullError:
-                    ensured = False  # transiently full: serve inline below
-            if ensure is not None and not ensured:
+                    self._ensure_device_materialized(oid)
+                    locs = [l for l in self.gcs.get_object_locations(oid)
+                            if l != node_id and self.nodes.get(l)
+                            and self.nodes[l].alive]
+                    if locs:
+                        self._transfer_object(oid, locs[0], node_id)
+                    elif not nm.store.contains(oid):
+                        self._recover_object(oid)
+                        # recovery may produce an inline value
+                        with self._lock:
+                            data = self.memory_store.get(oid)
+                        if data is not None:
+                            values[oid] = ("v", data)
+                            continue
+                        if not nm.store.contains(oid):
+                            locs = [l for l in
+                                    self.gcs.get_object_locations(oid)
+                                    if self.nodes.get(l)
+                                    and self.nodes[l].alive]
+                            if not locs:
+                                raise ObjectLostError(oid.hex())
+                            self._transfer_object(oid, locs[0], node_id)
+                except (ObjectStoreFullError, ObjectLostError):
+                    # the worker's node cannot take a copy right now (store
+                    # full past the wait budget): serve the bytes inline
+                    # from wherever they are instead of failing the get
+                    data = self._inline_bytes_anywhere(oid, prefer=node_id)
+                    if data is None:
+                        raise
+                    values[oid] = ("v", data)
+                    continue
+            need_ensure.append(oid)
+        # answering "local" is a promise the worker's DIRECT shm read will
+        # hit: restore-from-spill and pin briefly (the worker's store client
+        # is shm-only and cannot see the spill tier). Ensures are BATCHED
+        # per node — for a remote node each would otherwise be its own
+        # blocking agent round-trip, and a multi-object get against a
+        # degraded agent could park this request-pool thread for minutes.
+        if need_ensure:
+            ensured = self._ensure_resident_batch(nm, need_ensure)
+            for oid in need_ensure:
+                if ensured.get(oid, True):
+                    values[oid] = ("local", b"")
+                    continue
                 # the node's store is too full to restore (capacity held by
                 # executing tasks): serve the bytes inline as a last resort
                 # before declaring the object lost
@@ -2035,10 +2050,30 @@ class Runtime:
                 if data is None:
                     raise ObjectLostError(
                         oid.hex(), "could not materialize on worker's node")
-                values.append(("v", data))
+                values[oid] = ("v", data)
+        return [values[oid] for oid in oids]
+
+    def _ensure_resident_batch(self, nm, oids: List[bytes]) -> Dict[bytes, bool]:
+        """Restore-and-pin a set of objects on one node's store; one channel
+        round-trip for remote nodes (ensure_resident_many), a plain loop for
+        the local store."""
+        many = getattr(nm.store, "ensure_resident_many", None)
+        if many is not None:
+            try:
+                return many(oids)
+            except Exception:  # noqa: BLE001 — degrade to per-oid inline
+                return {oid: False for oid in oids}
+        ensure = getattr(nm.store, "ensure_resident", None)
+        out = {}
+        for oid in oids:
+            if ensure is None:
+                out[oid] = True
                 continue
-            values.append(("local", b""))
-        return values
+            try:
+                out[oid] = ensure(oid)
+            except ObjectStoreFullError:
+                out[oid] = False  # transiently full: caller serves inline
+        return out
 
     def _inline_bytes_anywhere(self, oid: bytes,
                                prefer: NodeID) -> Optional[bytes]:

@@ -170,10 +170,17 @@ def list_cluster_events(filters=None,
                         limit: int = 10000) -> List[Dict[str, Any]]:
     """Structured cluster events (the dashboard event module analog —
     NODE_ADDED/NODE_DEAD/TASK_RETRY/ACTOR_RESTARTING/WORKER_OOM_KILLED/
-    OBJECT_SPILLED, utils/events.py)."""
+    OBJECT_SPILLED, utils/events.py). Accepts both this module's
+    [(key, op, value)] filter tuples and events.list_events' {key: value}
+    dict form, so it composes like every sibling list_* API."""
     from ..utils import events
 
-    return events.list_events(filters, limit)
+    if isinstance(filters, dict):
+        return events.list_events(filters, limit)
+    # filter BEFORE limiting (like every sibling list_* API) and return the
+    # newest matches (like events.list_events does for the dict form)
+    rows = _apply_filters(events.list_events(None, limit=1 << 62), filters)
+    return rows[-limit:]
 
 
 # ------------------------------------------------------------- summaries

@@ -111,6 +111,15 @@ class TestEvents:
         assert events.list_events({"severity": events.WARNING})[-1][
             "label"] == "B"
 
+    def test_state_api_accepts_both_filter_forms(self):
+        """list_cluster_events must take the [(key, op, value)] tuples every
+        sibling state API uses, as well as the events-module dict form."""
+        events.emit("FORMS", "x", source="test")
+        dict_rows = state.list_cluster_events({"label": "FORMS"})
+        tuple_rows = state.list_cluster_events([("label", "=", "FORMS")])
+        assert dict_rows and tuple_rows
+        assert dict_rows[-1]["label"] == tuple_rows[-1]["label"] == "FORMS"
+
 
 class TestProfiling:
     @pytest.fixture(autouse=True)
