@@ -75,6 +75,9 @@ class RemoteFunction:
         self._fn_id = uuid.uuid4().bytes
         self._fn_blob: Optional[bytes] = None
         self._blob_lock = threading.Lock()
+        # everything but args/kwargs is fixed per RemoteFunction; building
+        # (and validating) it once keeps .remote() off the hot path's back
+        self._payload_template: Optional[dict] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **options) -> "RemoteFunction":
@@ -88,28 +91,37 @@ class RemoteFunction:
                 self._fn_blob = ser.dumps_function(self._fn)
             return self._fn_blob
 
+    def _template(self) -> dict:
+        tmpl = self._payload_template
+        if tmpl is None:
+            opts = self._options
+            resources: Dict[str, float] = dict(opts.get("resources") or {})
+            resources["CPU"] = opts.get("num_cpus", 1.0)
+            if opts.get("num_tpus"):
+                resources["TPU"] = opts["num_tpus"]
+            if opts.get("memory"):
+                resources["memory"] = opts["memory"]
+            tmpl = {
+                "name": opts.get("name",
+                                 getattr(self._fn, "__name__", "task")),
+                "fn_id": self._fn_id,
+                "fn_blob": self._blob(),
+                "num_returns": opts.get("num_returns", 1),
+                "resources": resources,
+                "strategy": _resolve_strategy(opts),
+                "max_retries": opts.get("max_retries", 4),
+                "retry_exceptions": bool(opts.get("retry_exceptions",
+                                                  False)),
+                "runtime_env": _validated_runtime_env(opts),
+            }
+            self._payload_template = tmpl
+        return tmpl
+
     def remote(self, *args, **kwargs):
-        opts = self._options
         enc_args, enc_kwargs = _encode_call(args, kwargs)
-        resources: Dict[str, float] = dict(opts.get("resources") or {})
-        resources["CPU"] = opts.get("num_cpus", 1.0)
-        if opts.get("num_tpus"):
-            resources["TPU"] = opts["num_tpus"]
-        if opts.get("memory"):
-            resources["memory"] = opts["memory"]
-        payload = {
-            "name": opts.get("name", getattr(self._fn, "__name__", "task")),
-            "fn_id": self._fn_id,
-            "fn_blob": self._blob(),
-            "args": enc_args,
-            "kwargs": enc_kwargs,
-            "num_returns": opts.get("num_returns", 1),
-            "resources": resources,
-            "strategy": _resolve_strategy(opts),
-            "max_retries": opts.get("max_retries", 4),
-            "retry_exceptions": bool(opts.get("retry_exceptions", False)),
-            "runtime_env": _validated_runtime_env(opts),
-        }
+        payload = dict(self._template())
+        payload["args"] = enc_args
+        payload["kwargs"] = enc_kwargs
         return_ids = _backend().submit_task(payload)
         refs = [ObjectRef(oid, _owner()) for oid in return_ids]
         return refs[0] if len(refs) == 1 else refs

@@ -1,0 +1,201 @@
+"""Peer-to-peer object transfer plane.
+
+Every node — the head and each node agent — runs a :class:`TransferServer`
+over its object store. All cross-node object movement is receiver-driven:
+the destination dials the source's server and streams chunks STRAIGHT into
+its own store allocation (``recv_bytes_into`` lands on the shm mapping, no
+intermediate buffer). The head brokers only *locations* (who has the object,
+where their server listens); payload bytes never transit the head.
+
+This is the reference object manager's design (receiver-driven pulls over
+dedicated gRPC streams, src/ray/object_manager/object_manager.h:114, chunked
+per object_manager.proto:63-67) with admission control collapsed to two
+caps: concurrent serving connections per source (the PullManager in-flight
+cap analog, pull_manager.h:47) and concurrent fetches per destination.
+
+Wire protocol (authenticated ``multiprocessing.connection``):
+    client -> server   {"oid": <bytes>}
+    server -> client   {"size": <int>}   or   {"error": <str>}
+    server -> client   raw chunk frames until ``size`` bytes are sent
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+_CONNECT_TIMEOUT = 20.0
+
+
+def _set_io_timeout(fd: int, seconds: float) -> None:
+    """SO_RCVTIMEO/SO_SNDTIMEO on the connection's underlying socket
+    (options live in the shared kernel socket, so setting them through a
+    dup'd fd sticks; 0 clears)."""
+    tv = struct.pack("ll", int(seconds), int((seconds % 1.0) * 1e6))
+    s = socket.socket(fileno=os.dup(fd))
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+    finally:
+        s.close()
+
+
+class TransferServer:
+    """Serves one store's objects to peers. Spilled objects are served from
+    the spill file (``store.read``) — serving never forces an allocation in
+    a full store."""
+
+    def __init__(self, store, authkey: bytes, chunk_size: int,
+                 bind_host: str = "0.0.0.0", max_conns: int = 4):
+        from multiprocessing.connection import Listener
+
+        self.store = store
+        self.chunk_size = chunk_size
+        self._authkey = authkey
+        # NO authkey on the Listener: accept() would run the challenge
+        # handshake on the single accept thread, letting one stalled peer
+        # wedge the whole server. The handshake runs per-connection on the
+        # serve thread instead, under a socket IO timeout.
+        self._listener = Listener((bind_host, 0))
+        self.port: int = self._listener.address[1]
+        self._sem = threading.BoundedSemaphore(max_conns)
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="xfer-accept").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except Exception:  # noqa: BLE001 — closed listener
+                if self._stop.is_set():
+                    return
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="xfer-serve").start()
+
+    def _serve_conn(self, conn) -> None:
+        """One request per connection; concurrency capped by the semaphore
+        so a burst of pulls cannot monopolize the host (admission control,
+        the PullManager cap analog)."""
+        from multiprocessing.connection import (
+            answer_challenge, deliver_challenge,
+        )
+
+        try:
+            # bounded handshake: a peer that never answers times out the
+            # recv instead of parking this thread forever (the accept
+            # thread is already safe — it only spawns us)
+            _set_io_timeout(conn.fileno(), 10.0)
+            deliver_challenge(conn, self._authkey)
+            answer_challenge(conn, self._authkey)
+            _set_io_timeout(conn.fileno(), 0.0)
+        except Exception:  # noqa: BLE001 — bad key / timeout / EOF
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._sem:
+            try:
+                req = conn.recv()
+                oid = req["oid"]
+                view = self.store.read(oid)
+                if view is None:
+                    conn.send({"error": "object not in store"})
+                    return
+                try:
+                    n = len(view) if isinstance(view, bytes) else view.nbytes
+                    conn.send({"size": n})
+                    mv = memoryview(view)
+                    try:
+                        for off in range(0, n, self.chunk_size):
+                            conn.send_bytes(mv[off:off + self.chunk_size])
+                    finally:
+                        mv.release()
+                finally:
+                    if isinstance(view, memoryview):
+                        self.store.release(oid)
+            except (EOFError, OSError, KeyError, TypeError):
+                pass
+            except Exception:  # noqa: BLE001 — a bad peer must not leak
+                pass  # the semaphore slot or kill the accept loop
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
+                 dst_store, chunk_size: int,
+                 timeout: float = 120.0) -> Optional[str]:
+    """Pull one object from a peer's TransferServer straight into
+    ``dst_store``. Returns None on success, an error string on failure.
+
+    The receive lands chunk-by-chunk in the store allocation itself
+    (``recv_bytes_into`` on the shm view) — no full-object staging buffer
+    anywhere, which is what keeps a GB-scale transfer O(chunk) in memory
+    on both ends."""
+    from multiprocessing.connection import Client
+
+    try:
+        conn = Client((host, port), authkey=authkey)
+    except Exception as e:  # noqa: BLE001 — peer down / auth refused
+        return f"connect to {host}:{port} failed: {e!r}"
+    try:
+        conn.send({"oid": oid})
+        hdr = conn.recv()
+        err = hdr.get("error")
+        if err:
+            return err
+        size = hdr["size"]
+        try:
+            buf = dst_store.create(oid, size)
+        except ValueError:
+            # create also refuses while a RACING fetch's copy is still
+            # unsealed and in flight — success is only real once the
+            # object is actually readable (the racer may die mid-stream
+            # and reclaim its partial copy)
+            deadline = time.monotonic() + min(timeout, 30.0)
+            while time.monotonic() < deadline:
+                if dst_store.contains(oid):
+                    return None
+                time.sleep(0.05)
+            return "concurrent transfer of this object never completed"
+        got = 0
+        try:
+            while got < size:
+                n = conn.recv_bytes_into(buf[got:])
+                got += n
+        except BaseException:
+            # partially-written object must not linger unsealed (it would
+            # block retries' create); seal-then-delete reclaims it
+            try:
+                dst_store.seal(oid)
+                dst_store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        dst_store.seal(oid)
+        return None
+    except (EOFError, OSError) as e:
+        return f"transfer from {host}:{port} failed: {e!r}"
+    except Exception as e:  # noqa: BLE001 — store full after wait, etc.
+        return repr(e)
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass

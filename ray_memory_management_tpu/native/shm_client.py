@@ -79,6 +79,39 @@ class ShmStore:
         self._map = mmap.mmap(self._file.fileno(), 0)
         self._mv = memoryview(self._map)
         self._closed = False
+        if create and self._prefault_ok(capacity):
+            # Pre-fault the arena in the background: first-touch page
+            # faults otherwise dominate the first pass of large writes
+            # (plasma pre-touches its mmap the same way). 23 is
+            # MADV_POPULATE_WRITE (Linux 5.14+), not yet in the mmap
+            # module; unsupported kernels just raise and skip.
+            import threading
+
+            def _prefault(m=self._map):
+                try:
+                    m.madvise(23)
+                except (OSError, ValueError):
+                    pass
+
+            threading.Thread(target=_prefault, daemon=True,
+                             name="shm-prefault").start()
+
+    @staticmethod
+    def _prefault_ok(capacity: int) -> bool:
+        """Populating dirties the WHOLE arena as resident tmpfs — only do
+        it when that commit is clearly affordable (< 1/4 of MemAvailable),
+        so a store sized near host RAM keeps lazy page commit."""
+        if os.environ.get("RMT_DISABLE_PREFAULT"):
+            return False
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        avail_kb = int(line.split()[1])
+                        return capacity < (avail_kb << 10) // 4
+        except (OSError, ValueError, IndexError):
+            pass
+        return False
 
     # -- object lifecycle -----------------------------------------------------
     def create(self, object_id: bytes, size: int) -> memoryview:

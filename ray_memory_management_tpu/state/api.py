@@ -180,7 +180,7 @@ def list_cluster_events(filters=None,
     # filter BEFORE limiting (like every sibling list_* API) and return the
     # newest matches (like events.list_events does for the dict form)
     rows = _apply_filters(events.list_events(None, limit=1 << 62), filters)
-    return rows[-limit:]
+    return rows[-limit:] if limit > 0 else []
 
 
 # ------------------------------------------------------------- summaries

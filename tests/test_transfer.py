@@ -1,0 +1,137 @@
+"""Peer-to-peer transfer plane: TransferServer + fetch_object.
+
+Unit-level (two stores in one process, TCP loopback between them) — the
+e2e agent-to-agent path is covered in test_multihost.py.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from ray_memory_management_tpu.config import Config
+from ray_memory_management_tpu.core.object_store import NodeObjectStore
+from ray_memory_management_tpu.core.transfer import TransferServer, fetch_object
+
+CHUNK = 1 << 20
+
+
+@pytest.fixture
+def two_stores():
+    cfg = Config(object_store_memory=64 << 20)
+    a = NodeObjectStore(f"/rmt_xferA_{os.getpid()}", cfg, create=True)
+    b = NodeObjectStore(f"/rmt_xferB_{os.getpid()}", cfg, create=True)
+    yield a, b
+    a.close(unlink=True)
+    b.close(unlink=True)
+
+
+def test_fetch_roundtrip(two_stores):
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        payload = np.arange(3 << 20, dtype=np.uint8).tobytes()
+        a.put_bytes(b"A" * 16, payload)
+        err = fetch_object("127.0.0.1", srv.port, key, b"A" * 16, b, CHUNK)
+        assert err is None
+        view = b.get(b"A" * 16)
+        assert bytes(view) == payload
+        del view
+        b.release(b"A" * 16)
+    finally:
+        srv.close()
+
+
+def test_fetch_serves_spilled_without_restore(two_stores):
+    """A spilled object streams from its spill file; the source store's
+    shm usage must not change (no restore allocation)."""
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        blobs = {bytes([i]) * 16: bytes([i]) * (16 << 20) for i in range(6)}
+        for oid, data in blobs.items():  # 96 MB into 64 MB: spills
+            a.put_bytes(oid, data)
+        assert a.spilled_count() > 0
+        spilled_oid = next(iter(a._spilled))
+        used_before = a.shm.usage()[0]
+        err = fetch_object("127.0.0.1", srv.port, key, spilled_oid, b, CHUNK)
+        assert err is None
+        assert a.shm.usage()[0] == used_before  # served from file, no restore
+        view = b.get(spilled_oid)
+        assert bytes(view[:4]) == blobs[spilled_oid][:4]
+        del view
+        b.release(spilled_oid)
+    finally:
+        srv.close()
+
+
+def test_fetch_missing_object_reports_error(two_stores):
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        err = fetch_object("127.0.0.1", srv.port, key, b"Z" * 16, b, CHUNK)
+        assert err is not None and "not in store" in err
+    finally:
+        srv.close()
+
+
+def test_fetch_existing_object_is_noop(two_stores):
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        a.put_bytes(b"C" * 16, b"src-version")
+        b.put_bytes(b"C" * 16, b"dst-version")
+        err = fetch_object("127.0.0.1", srv.port, key, b"C" * 16, b, CHUNK)
+        assert err is None
+        view = b.get(b"C" * 16)
+        assert bytes(view) == b"dst-version"  # racing copy kept, not clobbered
+        del view
+        b.release(b"C" * 16)
+    finally:
+        srv.close()
+
+
+def test_wrong_authkey_rejected(two_stores):
+    a, b = two_stores
+    srv = TransferServer(a, authkey=b"right-key", chunk_size=CHUNK)
+    try:
+        a.put_bytes(b"D" * 16, b"secret")
+        err = fetch_object("127.0.0.1", srv.port, b"wrong-key", b"D" * 16,
+                           b, CHUNK)
+        assert err is not None
+        assert not b.contains(b"D" * 16)
+    finally:
+        srv.close()
+
+
+def test_concurrent_fetches(two_stores):
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK, max_conns=2)
+    try:
+        oids = [bytes([40 + i]) * 16 for i in range(8)]
+        for i, oid in enumerate(oids):
+            a.put_bytes(oid, bytes([i]) * (1 << 20))
+        errs = []
+
+        def fetch(oid):
+            e = fetch_object("127.0.0.1", srv.port, key, oid, b, CHUNK)
+            if e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=fetch, args=(oid,))
+                   for oid in oids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        for oid in oids:
+            assert b.contains(oid)
+    finally:
+        srv.close()
