@@ -73,6 +73,28 @@ def _tpu_suite():
     return out or None
 
 
+def _scale_suite():
+    """Scalability rows (BASELINE.md second table) against real agent
+    processes; fault-isolated so a failure still reports the rest."""
+    try:
+        from ray_memory_management_tpu.utils.scale_bench import (
+            SCALE_BASELINE, run_scale_suite, vs_scale_baseline,
+        )
+
+        results = run_scale_suite()
+        ratios = vs_scale_baseline(results)
+        for k in sorted(results):
+            base = SCALE_BASELINE.get(k)
+            extra = f", {ratios[k]:5.2f}x" if k in ratios else ""
+            print(f"  scale {k:28s} {results[k]:12.1f} "
+                  f"(baseline {base if base is not None else '—'}{extra})",
+                  file=sys.stderr)
+        return {k: round(v, 2) for k, v in results.items()}
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  scale suite failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -94,6 +116,7 @@ def main() -> None:
     finally:
         rmt.shutdown()
 
+    scale = _scale_suite()
     tpu = _tpu_suite()
 
     line = {
@@ -103,6 +126,8 @@ def main() -> None:
         "unit": "x_baseline",
         "vs_baseline": round(gm, 4),
     }
+    if scale:
+        line["scale"] = scale
     if tpu:
         line["tpu"] = tpu
     print(json.dumps(line))

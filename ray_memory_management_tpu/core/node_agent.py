@@ -87,7 +87,20 @@ class NodeAgent:
         from multiprocessing.connection import Client, Listener
 
         self.channel = Client((head_host, head_port), authkey=authkey)
+        self._cluster_authkey = authkey
         self._channel_lock = threading.Lock()
+        # this host's reachable IP on the route to the head, and the head's
+        # IP as we see it — peers dial us at the former; obj_fetch frames
+        # with host="" mean "fetch from the head" and resolve to the latter
+        self._my_ip = "127.0.0.1"
+        self._head_ip = head_host
+        try:
+            sock = socket.socket(fileno=os.dup(self.channel.fileno()))
+            self._my_ip = sock.getsockname()[0]
+            self._head_ip = sock.getpeername()[0]
+            sock.close()
+        except OSError:
+            pass
         self._send({
             "type": "register_node",
             "num_cpus": num_cpus,
@@ -109,6 +122,25 @@ class NodeAgent:
         self.store = NodeObjectStore(self.store_name, self.config,
                                      create=True)
         self._push_bufs: Dict[bytes, memoryview] = {}
+
+        # peer-to-peer object plane: serve this store to other nodes and
+        # pull directly from theirs — payload bytes never transit the head
+        # (transfer.py; the reference's object-manager peer pulls,
+        # object_manager.h:114)
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .transfer import TransferServer, fetch_object as _fetch_object
+
+        self._fetch_object = _fetch_object
+        self.transfer_server = TransferServer(
+            self.store, authkey, self.config.object_manager_chunk_size)
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="agent-fetch")
+        self._send({
+            "type": "transfer_ready",
+            "host": self._my_ip,
+            "port": self.transfer_server.port,
+        })
 
         self._authkey = os.urandom(16)
         self._socket_path = f"/tmp/rmtA_{os.getpid()}_{os.urandom(4).hex()}.sock"
@@ -326,6 +358,28 @@ class NodeAgent:
             if isinstance(view, memoryview):
                 self.store.release(oid)
 
+    def _obj_fetch(self, msg: dict) -> None:
+        """Pull an object DIRECTLY from a peer's transfer server into this
+        store (receiver-driven transfer; host "" = the head). Runs on the
+        fetch pool so a slow source never blocks the object plane or the
+        channel loop."""
+        host = msg["host"] or self._head_ip
+        port, oid, req = msg["port"], msg["oid"], msg["req"]
+
+        def run():
+            try:
+                err = self._fetch_object(
+                    host, port, self._cluster_authkey, oid, self.store,
+                    self.config.object_manager_chunk_size)
+            except Exception as e:  # noqa: BLE001
+                err = repr(e)
+            try:
+                self._send({"type": "fetch_ack", "req": req, "error": err})
+            except (OSError, BrokenPipeError):
+                pass
+
+        self._fetch_pool.submit(run)
+
     def _obj_ensure(self, msg: dict) -> None:
         """Restore the object(s) into shm (if spilled) and pin briefly so
         the requesting worker's direct shm read cannot race a re-spill
@@ -401,6 +455,8 @@ class NodeAgent:
                         proc.terminate()
                     except Exception:
                         pass
+            elif t == "obj_fetch":
+                self._obj_fetch(msg)  # non-blocking: pool submit
             elif t in ("obj_push", "obj_chunk", "obj_seal", "obj_pull",
                        "obj_ensure"):
                 nbytes = len(msg["data"]) if t == "obj_chunk" else 0
@@ -447,6 +503,11 @@ class NodeAgent:
 
     def _shutdown(self) -> None:
         self._stop.set()
+        try:
+            self.transfer_server.close()
+        except Exception:
+            pass
+        self._fetch_pool.shutdown(wait=False)
         for proc in list(self._worker_procs.values()):
             try:
                 proc.terminate()

@@ -146,6 +146,9 @@ class RemoteNodeManager(NodeManager):
         self.gcs = gcs
         self.hostname = hostname
         self.agent_pid: Optional[int] = None  # pid on the agent's host
+        # (host, port) of the agent's TransferServer, set by its
+        # transfer_ready frame; None until then (fallback: channel push)
+        self.transfer_addr: Optional[tuple] = None
         self._channel_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, dict] = {}       # req -> accumulating state
@@ -264,15 +267,38 @@ class RemoteNodeManager(NodeManager):
         failed = set(state.get("failed") or ())
         return {oid: oid not in failed for oid in object_ids}
 
+    def fetch_from_peer(self, oid: bytes, host: str, port: int,
+                        timeout: float = 120.0) -> Optional[str]:
+        """Tell the agent to pull ``oid`` straight from a peer's transfer
+        server (host "" = the head). Returns None on success, else an error
+        string. Payload bytes never touch the head or this channel."""
+        if not self.alive:
+            return "node dead"
+        req = self._new_req()
+        with self._pending_lock:
+            state = self._pending.get(req)
+        if state is None or not self.channel_send(
+                {"type": "obj_fetch", "oid": oid, "host": host,
+                 "port": port, "req": req}):
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return "channel send failed"
+        ok = state["event"].wait(timeout)
+        with self._pending_lock:
+            self._pending.pop(req, None)
+        if not ok:
+            return "fetch timed out"
+        return state["error"]
+
     def on_channel_reply(self, msg: dict) -> None:
-        """push_ack / pull_data / ensure_ack frames routed here by the
-        runtime router."""
+        """push_ack / pull_data / ensure_ack / fetch_ack frames routed here
+        by the runtime router."""
         req = msg.get("req")
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None:
             return
-        if msg["type"] in ("push_ack", "ensure_ack"):
+        if msg["type"] in ("push_ack", "ensure_ack", "fetch_ack"):
             state["error"] = msg.get("error")
             state["failed"] = msg.get("failed")
             state["event"].set()

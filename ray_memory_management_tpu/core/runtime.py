@@ -144,6 +144,8 @@ class Runtime:
             max_workers=2, thread_name_prefix="rmt-xfer"
         )
         self._conn_send_locks: Dict[Any, threading.Lock] = {}
+        # lazy p2p transfer servers over LOCAL node stores (node_id -> srv)
+        self._xfer_servers: Dict[NodeID, Any] = {}
         self._wakeup_r, self._wakeup_w = os.pipe()
         self._stop = threading.Event()
         self.pg_manager = None  # set by placement_group module on first use
@@ -405,8 +407,12 @@ class Runtime:
                 self._bind_remote_worker(nm, handle)
                 return
             self._handle_worker_message(handle, inner)
-        elif mtype in ("push_ack", "pull_data", "ensure_ack"):
+        elif mtype in ("push_ack", "pull_data", "ensure_ack", "fetch_ack"):
             nm.on_channel_reply(msg)
+        elif mtype == "transfer_ready":
+            # the agent's p2p transfer server is up: record where peers
+            # (and the head) can pull this node's objects from
+            nm.transfer_addr = (msg["host"], msg["port"])
         elif mtype == "wdeath":
             handle = nm.worker_by_wid(msg["wid"])
             if handle is not None:
@@ -837,22 +843,29 @@ class Runtime:
         if nudge:
             self._wakeup()
 
-    def _on_dep_ready(self, oid: bytes) -> None:
+    def _deps_ready_locked(self, oid: bytes) -> bool:
+        """With self._lock held: resolve every task parked on ``oid``,
+        queueing newly-unblocked specs for the router's batched scheduling
+        pass. Returns True when the caller should nudge the router."""
         nudge = False
+        for task_id in self._dep_waiters.pop(oid, ()):
+            missing = self._waiting_deps.get(task_id)
+            if missing is None:
+                continue
+            missing.discard(oid)
+            if not missing:
+                del self._waiting_deps[task_id]
+                rec = self.tasks.get(task_id)
+                if rec:
+                    self._submit_q.append(rec.spec)
+                    if not self._submit_nudged:
+                        self._submit_nudged = True
+                        nudge = True
+        return nudge
+
+    def _on_dep_ready(self, oid: bytes) -> None:
         with self._lock:
-            for task_id in self._dep_waiters.pop(oid, ()):  # noqa: B020
-                missing = self._waiting_deps.get(task_id)
-                if missing is None:
-                    continue
-                missing.discard(oid)
-                if not missing:
-                    del self._waiting_deps[task_id]
-                    rec = self.tasks.get(task_id)
-                    if rec:
-                        self._submit_q.append(rec.spec)
-                        if not self._submit_nudged:
-                            self._submit_nudged = True
-                            nudge = True
+            nudge = self._deps_ready_locked(oid)
         if nudge:
             self._wakeup()
 
@@ -968,20 +981,78 @@ class Runtime:
         self._transfer_pool.submit(do_transfers)
         return False
 
+    def _local_transfer_server(self, node_id: NodeID):
+        """Lazy TransferServer over a LOCAL node's store, so remote agents
+        can pull its objects directly (the head serves like any peer)."""
+        from .transfer import TransferServer
+
+        with self._lock:
+            srv = self._xfer_servers.get(node_id)
+            if srv is None:
+                srv = TransferServer(
+                    self.nodes[node_id].store, self._authkey,
+                    self.config.object_manager_chunk_size)
+                self._xfer_servers[node_id] = srv
+        return srv
+
     def _transfer_object(self, oid: bytes, src: NodeID, dst: NodeID) -> None:
-        """Move an object between node stores: same-host pairs memcpy
-        between shm mappings; pairs involving a remote node ride the chunked
-        push/pull plane through the agent channel (ObjectManager Push/Pull,
-        object_manager.h:114)."""
+        """Move an object between node stores. Same-host pairs memcpy
+        between shm mappings. Pairs involving a remote node are
+        RECEIVER-DRIVEN over the p2p transfer plane (transfer.py): the
+        destination pulls chunks straight from the source's transfer
+        server, so payload bytes never transit the head and never queue
+        behind dispatch frames on the agent channel (the reference's
+        object-manager peer pull, object_manager.h:114). The channel
+        push/pull path remains as the fallback."""
         from .remote_node import RemoteNodeManager
 
+        src_nm = self.nodes[src]
+        dst_nm = self.nodes[dst]
+        src_remote = isinstance(src_nm, RemoteNodeManager)
+        dst_remote = isinstance(dst_nm, RemoteNodeManager)
+
+        if dst_remote:
+            # destination agent pulls from the source's server
+            if src_remote:
+                addr = src_nm.transfer_addr
+            else:
+                addr = ("", self._local_transfer_server(src).port)
+            if addr is not None:
+                err = dst_nm.fetch_from_peer(oid, addr[0], addr[1])
+                if err is None:
+                    self.gcs.add_object_location(oid, dst)
+                    return
+                events.emit(
+                    "TRANSFER_FALLBACK",
+                    f"p2p fetch of {oid.hex()[:8]} failed ({err}); "
+                    "falling back to channel push",
+                    severity=events.WARNING, source="object_manager")
+        elif src_remote:
+            # local destination: the head pulls from the source's server
+            # straight into the destination store (no staging buffer)
+            addr = src_nm.transfer_addr
+            if addr is not None:
+                from .transfer import fetch_object
+
+                err = fetch_object(
+                    addr[0], addr[1], self._authkey, oid, dst_nm.store,
+                    self.config.object_manager_chunk_size)
+                if err is None:
+                    self.gcs.add_object_location(oid, dst)
+                    return
+                events.emit(
+                    "TRANSFER_FALLBACK",
+                    f"p2p fetch of {oid.hex()[:8]} failed ({err}); "
+                    "falling back to channel pull",
+                    severity=events.WARNING, source="object_manager")
+
+        # same-host memcpy, or the channel push/pull fallback
         src_cli = self._store_client_for(src)
         view = src_cli.get(oid)  # local: shm view; remote: pulled bytes
         if view is None:
             raise ObjectLostError(oid.hex(), f"vanished from {src}")
         try:
-            dst_nm = self.nodes[dst]
-            if isinstance(dst_nm, RemoteNodeManager):
+            if dst_remote:
                 if not dst_nm.push_object(oid, view):
                     raise ObjectLostError(
                         oid.hex(), f"push to {dst_nm.hostname} failed")
@@ -1162,22 +1233,9 @@ class Runtime:
                         self.futures[oid] = fut = Future()
                     if not fut.done():
                         fut.set_result(True)
-                    # dep-waiter resolution, inlined under the same lock
-                    # (the _on_dep_ready body): ready tasks join the submit
-                    # queue for the router's batched scheduling pass
-                    for task_id in self._dep_waiters.pop(oid, ()):
-                        missing = self._waiting_deps.get(task_id)
-                        if missing is None:
-                            continue
-                        missing.discard(oid)
-                        if not missing:
-                            del self._waiting_deps[task_id]
-                            rec2 = self.tasks.get(task_id)
-                            if rec2:
-                                self._submit_q.append(rec2.spec)
-                                if not self._submit_nudged:
-                                    self._submit_nudged = True
-                                    nudge = True
+                    # dep-waiter resolution under the same (batch-wide) lock
+                    if self._deps_ready_locked(oid):
+                        nudge = True
                 rec = self.tasks.get(m["task_id"])
                 if rec:
                     rec.state = "FINISHED"
@@ -1808,8 +1866,34 @@ class Runtime:
         return ObjectLostError(oid.hex(), "recovery retries exhausted")
 
     def _read_from_stores(self, oid: bytes) -> Tuple[Any, bool]:
+        from .remote_node import RemoteNodeManager
+
         locs = self.gcs.get_object_locations(oid)
-        for node_id in locs:
+        local = [l for l in locs
+                 if not isinstance(self.nodes.get(l), RemoteNodeManager)]
+        remote = [l for l in locs if l not in set(local)]
+        # remote-only objects: localize into the head store over the p2p
+        # plane first — a driver get used to buffer the WHOLE object in
+        # head RAM (b"".join of pulled chunks); fetching into the store
+        # keeps it O(chunk), zero-copy on read, spill-managed, and cached
+        # for the next get
+        for node_id in remote if not local else ():
+            nm = self.nodes.get(node_id)
+            if nm is None or not nm.alive:
+                continue
+            addr = getattr(nm, "transfer_addr", None)
+            if addr is None:
+                continue
+            from .transfer import fetch_object
+
+            head = self.head_node()
+            err = fetch_object(addr[0], addr[1], self._authkey, oid,
+                               head.store, self.config.object_manager_chunk_size)
+            if err is None:
+                self.gcs.add_object_location(oid, head.node_id)
+                local = [head.node_id]
+                break
+        for node_id in local + remote:
             nm = self.nodes.get(node_id)
             if nm is None or not nm.alive:
                 continue
@@ -2243,6 +2327,11 @@ class Runtime:
         self._hb.join(timeout=2.0)
         self._request_pool.shutdown(wait=False, cancel_futures=True)
         self._transfer_pool.shutdown(wait=False, cancel_futures=True)
+        for srv in self._xfer_servers.values():
+            try:
+                srv.close()
+            except Exception:
+                pass
         for nm in self.nodes.values():
             try:
                 nm.shutdown(unlink_store=True)

@@ -93,7 +93,11 @@ class TransferServer:
             _set_io_timeout(conn.fileno(), 10.0)
             deliver_challenge(conn, self._authkey)
             answer_challenge(conn, self._authkey)
-            _set_io_timeout(conn.fileno(), 0.0)
+            # keep a (longer) IO timeout for the serve itself: a peer that
+            # stalls mid-download would otherwise hold a semaphore slot and
+            # a store read ref forever — max_conns such peers would wedge
+            # this node's whole p2p plane
+            _set_io_timeout(conn.fileno(), 60.0)
         except Exception:  # noqa: BLE001 — bad key / timeout / EOF
             try:
                 conn.close()
@@ -147,11 +151,27 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
     The receive lands chunk-by-chunk in the store allocation itself
     (``recv_bytes_into`` on the shm view) — no full-object staging buffer
     anywhere, which is what keeps a GB-scale transfer O(chunk) in memory
-    on both ends."""
-    from multiprocessing.connection import Client
+    on both ends.
+
+    Every IO step is bounded: connect by _CONNECT_TIMEOUT, each recv/send
+    by a per-operation socket timeout — a suspended or partitioned source
+    fails the fetch instead of hanging the calling thread (and, on an
+    agent, instead of pinning the oid unsealed forever, which would block
+    the head's push fallback)."""
+    from multiprocessing.connection import (
+        Connection, answer_challenge, deliver_challenge,
+    )
 
     try:
-        conn = Client((host, port), authkey=authkey)
+        sock = socket.create_connection((host, port),
+                                        timeout=_CONNECT_TIMEOUT)
+        sock.settimeout(None)  # timeouts via SO_RCVTIMEO below
+        conn = Connection(sock.detach())
+        # per-operation bound: a healthy stream always progresses within
+        # seconds; 30s of silence on any single recv means the peer is gone
+        _set_io_timeout(conn.fileno(), min(timeout, 30.0))
+        answer_challenge(conn, authkey)
+        deliver_challenge(conn, authkey)
     except Exception as e:  # noqa: BLE001 — peer down / auth refused
         return f"connect to {host}:{port} failed: {e!r}"
     try:
@@ -180,10 +200,13 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                 n = conn.recv_bytes_into(buf[got:])
                 got += n
         except BaseException:
-            # partially-written object must not linger unsealed (it would
-            # block retries' create); seal-then-delete reclaims it
+            # abort the unsealed create so retries can re-allocate.
+            # delete() handles unsealed entries directly (obj_delete
+            # "aborts an unsealed create", shmstore.cpp:379) — sealing
+            # first would briefly publish the TRUNCATED object as real,
+            # and a concurrent reader's ref could make that permanent
+            del buf
             try:
-                dst_store.seal(oid)
                 dst_store.delete(oid)
             except Exception:  # noqa: BLE001
                 pass

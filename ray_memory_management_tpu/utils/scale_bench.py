@@ -1,0 +1,132 @@
+"""Scalability benchmark suite.
+
+Mirrors the reference's release scalability benchmarks
+(release/benchmarks/{many_actors,many_pgs,many_tasks}.py and
+release/nightly_tests/object_store — published numbers in
+release/release_logs/2.0.0/{benchmarks,scalability}/) scaled to a
+single-host run: the shapes are the same (actor churn, PG churn, task
+fan-out across real agent processes, object broadcast, cross-node
+bandwidth), the counts are tuned so the whole section stays under a few
+minutes. Baselines below are the reference's published rates, so ratios
+compare like-for-like where a direct counterpart exists.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+# reference numbers (BASELINE.md scalability table)
+SCALE_BASELINE = {
+    "many_actors_per_s": 510.0,        # 10k actors, multi-node AWS
+    "many_pgs_per_s": 16.9,            # 1k PGs, multi-node AWS
+    "many_tasks_per_s": 27.6,          # 10k long tasks (scheduling rate)
+    "broadcast_gbps": 0.65,            # 1 GiB to 50 nodes in 76.7s ~= 0.65 GB/s aggregate
+    "cross_node_gbps": None,           # no direct reference row (p2p plane)
+}
+
+
+def run_scale_suite(n_actors: int = 500, n_tasks: int = 10_000,
+                    n_pgs: int = 200, broadcast_mb: int = 256,
+                    n_agents: int = 2) -> Dict[str, float]:
+    """Run against a fresh runtime with ``n_agents`` real agent processes.
+    Returns {metric: value}."""
+    import numpy as np
+
+    import ray_memory_management_tpu as rmt
+    from ray_memory_management_tpu.core.placement_group import (
+        placement_group, remove_placement_group,
+    )
+    from ray_memory_management_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    results: Dict[str, float] = {}
+    rt = rmt.init(num_cpus=8)
+    try:
+        agent_ids = [rt.add_remote_node_process(num_cpus=4)
+                     for _ in range(n_agents)]
+
+        # -- many actors: create + first call round-trip ---------------------
+        @rmt.remote(num_cpus=0)
+        class Probe:
+            def ready(self):
+                return b"ok"
+
+        t0 = time.perf_counter()
+        actors = [Probe.remote() for _ in range(n_actors)]
+        rmt.get([a.ready.remote() for a in actors], timeout=600)
+        results["many_actors_per_s"] = n_actors / (time.perf_counter() - t0)
+        for a in actors:
+            rmt.kill(a)
+        del actors
+
+        # -- many tasks across real agent nodes ------------------------------
+        @rmt.remote(max_retries=0)
+        def noop():
+            return b"ok"
+
+        t0 = time.perf_counter()
+        refs = [noop.options(scheduling_strategy="SPREAD").remote()
+                for _ in range(n_tasks)]
+        rmt.get(refs, timeout=900)
+        results["many_tasks_per_s"] = n_tasks / (time.perf_counter() - t0)
+        del refs
+
+        # -- many placement groups -------------------------------------------
+        t0 = time.perf_counter()
+        for _ in range(n_pgs):
+            pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+            pg.wait(10)
+            remove_placement_group(pg)
+        results["many_pgs_per_s"] = n_pgs / (time.perf_counter() - t0)
+
+        # -- broadcast one object to every agent node ------------------------
+        blob = np.ones(broadcast_mb << 18, np.float32)  # broadcast_mb MB
+        ref = rmt.put(blob)
+
+        @rmt.remote(max_retries=0)
+        def touch(arr):
+            return int(arr[0])
+
+        t0 = time.perf_counter()
+        outs = [touch.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=False)).remote(ref)
+            for nid in agent_ids]
+        assert rmt.get(outs, timeout=600) == [1] * n_agents
+        dt = time.perf_counter() - t0
+        results["broadcast_gbps"] = (broadcast_mb / 1024) * n_agents / dt
+
+        # -- cross-node (agent->agent) p2p bandwidth -------------------------
+        if n_agents >= 2:
+            @rmt.remote(max_retries=0)
+            def produce(mb):
+                import numpy as _np
+
+                return _np.ones(mb << 18, _np.float32)
+
+            src, dst = agent_ids[0], agent_ids[1]
+            pref = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=src, soft=False)).remote(broadcast_mb)
+            rmt.wait([pref], timeout=600)
+            t0 = time.perf_counter()
+            out = touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=dst, soft=False)).remote(pref)
+            assert rmt.get(out, timeout=600) == 1
+            dt = time.perf_counter() - t0
+            results["cross_node_gbps"] = (broadcast_mb / 1024) / dt
+    finally:
+        rmt.shutdown()
+    return results
+
+
+def vs_scale_baseline(results: Dict[str, float]) -> Dict[str, float]:
+    out = {}
+    for k, v in results.items():
+        base = SCALE_BASELINE.get(k)
+        if base:
+            out[k] = v / base
+    return out

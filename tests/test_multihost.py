@@ -111,3 +111,105 @@ def test_remote_node_death_triggers_lineage_recovery(head_and_agent):
 
     arr = rmt.get(ref, timeout=120)
     assert float(arr[0]) == 7.0 and arr.shape == (400_000,)
+
+
+def test_agent_to_agent_direct_transfer():
+    """An object produced on agent A and consumed on agent B moves over the
+    p2p transfer plane: the head's channel push/pull must never carry the
+    payload (both legacy paths are broken for the duration to prove it)."""
+    from ray_memory_management_tpu.core.remote_node import RemoteNodeManager
+
+    rt = rmt.init(num_cpus=2)
+    try:
+        node_a = rt.add_remote_node_process(num_cpus=2)
+        node_b = rt.add_remote_node_process(num_cpus=2)
+        # wait for both agents' transfer servers to announce themselves
+        deadline = time.time() + 20
+        while time.time() < deadline and not all(
+                getattr(rt.nodes[n], "transfer_addr", None)
+                for n in (node_a, node_b)):
+            time.sleep(0.1)
+        assert rt.nodes[node_a].transfer_addr, "agent A transfer server"
+        assert rt.nodes[node_b].transfer_addr, "agent B transfer server"
+
+        calls = []
+
+        def tracking_pull(self, object_id, timeout=120.0):
+            calls.append(("pull", object_id))
+            raise AssertionError("legacy channel pull used for payload")
+
+        def tracking_push(self, object_id, view, timeout=120.0):
+            calls.append(("push", object_id))
+            raise AssertionError("legacy channel push used for payload")
+
+        orig_pull = RemoteNodeManager.pull_object
+        orig_push = RemoteNodeManager.push_object
+        RemoteNodeManager.pull_object = tracking_pull
+        RemoteNodeManager.push_object = tracking_push
+        try:
+            @rmt.remote(max_retries=0)
+            def produce():
+                return np.full(1_500_000, 5.0, dtype=np.float32)  # 6 MB
+
+            @rmt.remote(max_retries=0)
+            def consume(arr):
+                return float(arr.sum())
+
+            ref = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_a, soft=False)).remote()
+            out = consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_b, soft=False)).remote(ref)
+            assert rmt.get(out, timeout=120) == 1_500_000 * 5.0
+            assert not calls, f"head touched the payload: {calls}"
+        finally:
+            RemoteNodeManager.pull_object = orig_pull
+            RemoteNodeManager.push_object = orig_push
+    finally:
+        rmt.shutdown()
+
+
+def test_dispatch_stays_responsive_during_big_transfer():
+    """Task dispatch frames must not queue behind a large object transfer:
+    the payload rides a dedicated peer connection, not the agent channel."""
+    rt = rmt.init(num_cpus=2)
+    try:
+        node_a = rt.add_remote_node_process(num_cpus=2)
+        node_b = rt.add_remote_node_process(num_cpus=2)
+
+        @rmt.remote(max_retries=0)
+        def produce():
+            return np.ones(16_000_000, dtype=np.float32)  # 64 MB
+
+        @rmt.remote(max_retries=0)
+        def consume(arr):
+            return float(arr[0])
+
+        @rmt.remote(max_retries=0)
+        def ping():
+            return "pong"
+
+        big_ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_a, soft=False)).remote()
+        rmt.wait([big_ref], timeout=120)
+        # start the big A->B transfer, then immediately drive small tasks
+        # to B over the same agent channel
+        out = consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_b, soft=False)).remote(big_ref)
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            assert rmt.get(ping.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_b, soft=False)).remote(),
+                timeout=120) == "pong"
+            lat.append(time.perf_counter() - t0)
+        assert rmt.get(out, timeout=120) == 1.0
+        # generous bound for a loaded CI box; the old single-channel path
+        # would serialize the full 64 MB ahead of the ping dispatch
+        assert min(lat) < 2.0, f"dispatch latencies during transfer: {lat}"
+    finally:
+        rmt.shutdown()
