@@ -37,16 +37,30 @@ def _tpu_suite():
         print(f"  tpu suite unavailable: {e!r}", file=sys.stderr)
         return None
     out = {}
-    try:
-        mfu = tpu_bench.train_step_mfu()
-        print(
-            f"  tpu train gpt2-small-class: {mfu['tokens_per_s']:,.0f} tok/s"
-            f"  MFU {mfu['mfu']:.3f}  step {mfu['step_ms']:.1f} ms"
-            f"  ({mfu['n_params']/1e6:.0f}M params)", file=sys.stderr)
-        out["train_tokens_per_s"] = round(mfu["tokens_per_s"], 1)
-        out["train_mfu"] = round(mfu["mfu"], 4)
-    except Exception as e:  # pragma: no cover - hardware variance
-        print(f"  tpu train bench failed: {e!r}", file=sys.stderr)
+    train_rows = [
+        # (tag, kwargs): the flagship row plus the long-context and the
+        # ~1B-param rows (VERDICT r2: bench the bigger model and S=4096)
+        ("gpt2-small S=1024", {}),
+        ("gpt2-small S=4096", {"seq_len": 4096, "batch_size": 2}),
+        ("llama-1b S=2048", {"preset": "llama-1b", "seq_len": 2048,
+                             "batch_size": 4, "bf16_params": True}),
+    ]
+    for tag, kw in train_rows:
+        try:
+            mfu = tpu_bench.train_step_mfu(**kw)
+            print(
+                f"  tpu train {tag}: {mfu['tokens_per_s']:,.0f} tok/s"
+                f"  MFU {mfu['mfu']:.3f}  step {mfu['step_ms']:.1f} ms"
+                f"  ({mfu['n_params']/1e6:.0f}M params)", file=sys.stderr)
+            if tag == "gpt2-small S=1024":
+                out["train_tokens_per_s"] = round(mfu["tokens_per_s"], 1)
+                out["train_mfu"] = round(mfu["mfu"], 4)
+            else:
+                out.setdefault("train_rows", {})[tag] = {
+                    "tokens_per_s": round(mfu["tokens_per_s"], 1),
+                    "mfu": round(mfu["mfu"], 4)}
+        except Exception as e:  # pragma: no cover - hardware variance
+            print(f"  tpu train bench {tag} failed: {e!r}", file=sys.stderr)
     try:
         fa = tpu_bench.flash_attention_bench()
         for S, d in fa.items():
@@ -58,6 +72,16 @@ def _tpu_suite():
             str(S): round(d["speedup"], 2) for S, d in fa.items()}
     except Exception as e:  # pragma: no cover
         print(f"  tpu flash bench failed: {e!r}", file=sys.stderr)
+    try:
+        sv = tpu_bench.llm_serving_bench()
+        print(
+            f"  tpu serve-LM decode: {sv['decode_tokens_per_s']:,.0f} tok/s"
+            f"  ({sv['requests_per_s']:.1f} req/s, "
+            f"{sv.get('batches', '?')} batches)", file=sys.stderr)
+        out["serve_decode_tokens_per_s"] = round(
+            sv["decode_tokens_per_s"], 1)
+    except Exception as e:  # pragma: no cover
+        print(f"  tpu serve bench failed: {e!r}", file=sys.stderr)
     try:
         bw = tpu_bench.allreduce_busbw()
         if bw is None:

@@ -39,6 +39,10 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     attention: str = "auto"           # auto|flash|ref|ring|ulysses
     remat: bool = False               # jax.checkpoint each block
+    # layer-scan unroll factor: 1 compiles O(1) in depth; n_layers trades
+    # compile time for a few % step time (XLA drops the scan-carry
+    # dynamic-update-slice traffic when the loop is unrolled)
+    scan_unroll: int = 1
     # Mixture-of-Experts FFN (ops/moe.py); 0 = dense MLP. Net-new vs the
     # reference (SURVEY.md §2.4: EP absent there).
     n_experts: int = 0
@@ -233,7 +237,8 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig, mesh=None,
     def scan_body(x, layer):
         return block_fn(x, layer)
 
-    x, aux = lax.scan(scan_body, x, params["layers"])
+    x, aux = lax.scan(scan_body, x, params["layers"],
+                      unroll=min(cfg.scan_unroll, cfg.n_layers))
     x = _rmsnorm(x, params["final_ln"])
     # bf16 operands on the MXU, fp32 accumulation/output — fp32 operands
     # would run the largest matmul in the model at a fraction of MXU rate

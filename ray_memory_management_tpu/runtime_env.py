@@ -11,24 +11,31 @@ fields apply at execution time and roll back afterwards:
   - ``working_dir``: a directory copied once into a per-env cache
     (URI-cache analog, uri_cache.py) and chdir'd into
   - ``py_modules``: local dirs/files prepended to sys.path
+  - ``pip``: packages installed ONCE into a content-keyed virtualenv
+    (the reference's pip.py + uri_cache.py); the env's site-packages is
+    prepended to sys.path around the call. List form (``["pkg"]``) or
+    dict form (``{"packages": [...], "extra_args": [...]}`` — extra_args
+    is where offline installs pass ``--no-index --find-links ...``).
 
-``conda``/``pip``/``container`` would need process-level isolation; they
-raise a clear error rather than silently half-working (this image also
-forbids installs). The plugin hook mirrors plugin.py: a callable
-``setup(env_dict) -> context_manager`` registered by name.
+``conda``/``container`` would need process-level isolation; they raise a
+clear error rather than silently half-working. The plugin hook mirrors
+plugin.py: a callable ``setup(env_dict) -> context_manager`` registered
+by name.
 """
 
 from __future__ import annotations
 
 import contextlib
 import hashlib
+import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
-_UNSUPPORTED = ("conda", "pip", "container")
+_UNSUPPORTED = ("conda", "container")
 _plugins: Dict[str, Callable[[Any], Any]] = {}
 
 
@@ -45,8 +52,8 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             raise ValueError(
                 f"runtime_env[{key!r}] needs process-level isolation that "
                 "the pooled host-process worker model does not provide "
-                "(and this environment forbids package installs)")
-        if key not in ("env_vars", "working_dir", "py_modules") and \
+                "(use 'pip' for package installs)")
+        if key not in ("env_vars", "working_dir", "py_modules", "pip") and \
                 key not in _plugins:
             raise ValueError(f"unknown runtime_env key {key!r}")
     env_vars = runtime_env.get("env_vars")
@@ -54,6 +61,11 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             isinstance(k, str) and isinstance(v, str)
             for k, v in env_vars.items()):
         raise ValueError("env_vars must be Dict[str, str]")
+    pip = runtime_env.get("pip")
+    if pip is not None and not isinstance(pip, (list, dict)):
+        raise ValueError(
+            "pip must be a list of requirements or "
+            "{'packages': [...], 'extra_args': [...]}")
     return dict(runtime_env)
 
 
@@ -96,6 +108,56 @@ def _materialize_working_dir(src: str) -> str:
     return dest
 
 
+_PIP_CACHE = os.path.join(tempfile.gettempdir(), "rmt_runtime_env_pip")
+
+
+def _pip_spec(spec) -> tuple:
+    if isinstance(spec, dict):
+        return (list(spec.get("packages") or []),
+                list(spec.get("extra_args") or []))
+    return list(spec), []
+
+
+def _pip_env_site_packages(spec) -> str:
+    """Install the requested packages ONCE into a content-keyed target
+    directory (``pip install --target``) and return it for sys.path. The
+    cache key is the requirement list — the reference's pip.py builds an
+    env per runtime_env hash under its URI cache the same way
+    (python/ray/_private/runtime_env/pip.py, uri_cache.py). A --target
+    dir (rather than a virtualenv) layers cleanly over a pooled worker's
+    existing interpreter: the base environment stays visible and the env
+    applies/rolls back as a single sys.path entry."""
+    packages, extra_args = _pip_spec(spec)
+    key = hashlib.sha256(
+        json.dumps([sorted(packages), extra_args]).encode()).hexdigest()[:16]
+    dest = os.path.join(_PIP_CACHE, key)
+    marker = os.path.join(dest, ".rmt_ready")
+    if not os.path.exists(marker):
+        os.makedirs(_PIP_CACHE, exist_ok=True)
+        tmp = tempfile.mkdtemp(dir=_PIP_CACHE, prefix=".staging-")
+        try:
+            target = os.path.join(tmp, "env")
+            os.makedirs(target)
+            if packages:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "pip", "install", "--quiet",
+                     "--disable-pip-version-check", "--target", target,
+                     *extra_args, *packages],
+                    capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"pip install {packages} failed:\n{proc.stderr}")
+            with open(os.path.join(target, ".rmt_ready"), "w") as f:
+                f.write("ok")
+            try:
+                os.rename(target, dest)
+            except OSError:
+                pass  # another materializer won the race
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
 def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
     """Apply an env for the remainder of this process — used for actors,
     whose worker process is dedicated to them (no rollback needed, and
@@ -111,6 +173,9 @@ def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
         sys.path.insert(0, target)
     for mod in runtime_env.get("py_modules") or []:
         sys.path.insert(0, os.path.abspath(mod))
+    pip = runtime_env.get("pip")
+    if pip:
+        sys.path.insert(0, _pip_env_site_packages(pip))
     for key, value in runtime_env.items():
         if key in _plugins:
             cm = _plugins[key](value)
@@ -129,6 +194,7 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd: Optional[str] = None
     saved_path_len = len(sys.path)
+    pip_dir: Optional[str] = None
     stack = contextlib.ExitStack()
     try:
         for k, v in (runtime_env.get("env_vars") or {}).items():
@@ -142,12 +208,31 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
             sys.path.insert(0, target)
         for mod in runtime_env.get("py_modules") or []:
             sys.path.insert(0, os.path.abspath(mod))
+        pip = runtime_env.get("pip")
+        if pip:
+            pip_dir = _pip_env_site_packages(pip)
+            sys.path.insert(0, pip_dir)
+            # a fresh import path must not serve stale negative caches
+            import importlib
+
+            importlib.invalidate_caches()
         for key, value in runtime_env.items():
             if key in _plugins:
                 stack.enter_context(_plugins[key](value))
         yield
     finally:
         stack.close()
+        if pip_dir is not None:
+            # evict modules imported FROM the env so the next task (which
+            # may not request this env) cannot see them through the
+            # sys.modules cache; pure-python unload only — C extensions
+            # stay mapped, which is why the reference dedicates workers
+            # to pip envs instead
+            prefix = pip_dir + os.sep
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None) or ""
+                if f.startswith(prefix):
+                    del sys.modules[name]
         del sys.path[: max(0, len(sys.path) - saved_path_len)]
         if saved_cwd is not None:
             try:

@@ -22,3 +22,4 @@ from .deployment import (  # noqa: F401
     deployment,
 )
 from .handle import DeploymentHandle  # noqa: F401
+from .llm import DynamicBatcher, LLMServer, llm_deployment  # noqa: F401

@@ -1,0 +1,256 @@
+"""LM serving: a deployment wrapping the KV-cached decode path.
+
+The reference serves models through generic deployments plus the
+``serve.batch`` request coalescer (python/ray/serve/batching.py:279;
+replica loop serve/_private/replica.py:250). Here the same two pieces are
+TPU-shaped:
+
+  - :class:`DynamicBatcher` — a thread-based request coalescer: callers
+    block, a background thread collects up to ``max_batch_size`` requests
+    within ``batch_wait_timeout_s`` and runs them as ONE model call. On a
+    TPU the batch dimension is nearly free (MXU width), so coalescing is
+    the difference between 1x and Nx decode throughput under load.
+  - :class:`LLMServer` — the deployment class: holds params on device,
+    pads each batch to a fixed shape bucket (batch -> ``max_batch_size``
+    rows, prompt -> multiple of ``pad_multiple``), so XLA compiles ONE
+    prefill+decode program per bucket and reuses it forever
+    (models/gpt.py generate's compile-once contract).
+
+Requests carry token ids (``{"tokens": [...]}``) or plain text
+(``{"text": ...}``, byte-level fallback tokenizer) — the deployment is
+model-complete without shipping a tokenizer dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .deployment import deployment
+
+
+class _Pending:
+    __slots__ = ("item", "event", "result", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class DynamicBatcher:
+    """Coalesce concurrent blocking calls into batched ``fn`` invocations.
+
+    ``fn(items: list) -> list`` runs on the batcher thread; callers park
+    in :meth:`submit` until their result is ready. The first arrival opens
+    a window of ``batch_wait_timeout_s``; the batch launches when the
+    window closes or ``max_batch_size`` is reached, whichever is first
+    (the reference's @serve.batch semantics, batching.py:279)."""
+
+    def __init__(self, fn, max_batch_size: int = 8,
+                 batch_wait_timeout_s: float = 0.01):
+        self._fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self._q: List[_Pending] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-batcher")
+        self._thread.start()
+
+    def submit(self, item, timeout: float = 300.0):
+        p = _Pending(item)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher closed")
+            self._q.append(p)
+            self._cond.notify()
+        if not p.event.wait(timeout):
+            raise TimeoutError("batched call timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait(timeout=1.0)
+                if self._stop:
+                    return
+                deadline = time.monotonic() + self.batch_wait_timeout_s
+                while (len(self._q) < self.max_batch_size
+                       and time.monotonic() < deadline):
+                    self._cond.wait(timeout=max(
+                        0.0, deadline - time.monotonic()))
+                batch = self._q[: self.max_batch_size]
+                del self._q[: self.max_batch_size]
+            try:
+                results = self._fn([p.item for p in batch])
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"batch fn returned {len(results)} results for "
+                        f"{len(batch)} items")
+                for p, r in zip(batch, results):
+                    p.result = r
+                    p.event.set()
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            drained = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for p in drained:  # fail parked callers promptly, not by timeout
+            p.error = RuntimeError("batcher closed")
+            p.event.set()
+
+
+def _bytes_tokenize(text: str, vocab_size: int) -> List[int]:
+    """Byte-level fallback: utf-8 bytes offset past the special range."""
+    return [2 + (b % (vocab_size - 2)) for b in text.encode()]
+
+
+class LLMServer:
+    """Deployment class: KV-cached batched generation on one chip.
+
+    ``user_config`` (reconfigure) can retune ``max_new_tokens`` /
+    ``temperature`` without a redeploy."""
+
+    def __init__(self, preset: str = "gpt2-small",
+                 max_batch_size: int = 8,
+                 batch_wait_timeout_s: float = 0.01,
+                 max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 pad_multiple: int = 64,
+                 seed: int = 0):
+        import jax
+
+        from ..models import gpt
+
+        self.cfg = gpt.PRESETS[preset]
+        if max_new_tokens + pad_multiple > self.cfg.max_seq:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} leaves no room for a "
+                f"{pad_multiple}-token prompt bucket within the model's "
+                f"max_seq={self.cfg.max_seq}")
+        self.gpt = gpt
+        self.params = gpt.init_params(jax.random.PRNGKey(seed), self.cfg)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.pad_multiple = pad_multiple
+        self.max_batch_size = max_batch_size
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._stats = {"requests": 0, "batches": 0, "generated_tokens": 0}
+        self._batcher = DynamicBatcher(
+            self._run_batch, max_batch_size=max_batch_size,
+            batch_wait_timeout_s=batch_wait_timeout_s)
+
+    # -- config ---------------------------------------------------------------
+    def reconfigure(self, user_config: Optional[dict]) -> None:
+        if not user_config:
+            return
+        self.max_new_tokens = int(user_config.get(
+            "max_new_tokens", self.max_new_tokens))
+        self.temperature = float(user_config.get(
+            "temperature", self.temperature))
+
+    # -- request surface ------------------------------------------------------
+    def __call__(self, request: Any = None) -> Dict[str, Any]:
+        """HTTP entrypoint: {"tokens": [...]} or {"text": "..."}. Returns
+        {"tokens": [...]}. The continuation length is the deployment's
+        ``max_new_tokens`` (per-request overrides would defeat the
+        one-compiled-program-per-bucket batching; retune it via
+        ``user_config`` reconfigure instead)."""
+        if isinstance(request, str):
+            request = {"text": request}
+        request = request or {}
+        tokens = request.get("tokens")
+        if tokens is None:
+            tokens = _bytes_tokenize(request.get("text", ""),
+                                     self.cfg.vocab_size)
+        if not tokens:
+            tokens = [1]
+        out = self.generate(tokens)
+        return {"tokens": out, "prompt_len": len(tokens)}
+
+    def generate(self, tokens: Sequence[int]) -> List[int]:
+        """Generate ``max_new_tokens`` continuation ids for one prompt
+        (batched under the hood with whatever arrives concurrently)."""
+        return self._batcher.submit(list(tokens))
+
+    def stats(self) -> dict:
+        return dict(self._stats)
+
+    # -- batched model call ---------------------------------------------------
+    def _run_batch(self, prompts: List[List[int]]) -> List[List[int]]:
+        """One prefill+decode for a batch of prompts. Shapes are bucketed:
+        batch padded to max_batch_size rows, prompt length to the next
+        pad_multiple — one compiled program per (bucket, steps), reused
+        across calls.
+
+        Rows shorter than the bucket are right-padded by repeating their
+        own final token. Equal-length batches (the common serving shape)
+        are exact; a shorter row in a mixed batch conditions on those
+        repeats — the standard padded-batch approximation (exact handling
+        would need per-row position masks through prefill)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        import jax
+
+        n = len(prompts)
+        lens = [len(p) for p in prompts]
+        s0 = max(lens)
+        bucket = ((s0 + self.pad_multiple - 1)
+                  // self.pad_multiple) * self.pad_multiple
+        bucket = min(bucket, self.cfg.max_seq - self.max_new_tokens)
+        B = self.max_batch_size
+        arr = np.ones((B, bucket), np.int32)  # dummy rows: token 1
+        for i, p in enumerate(prompts):
+            p = p[-bucket:]  # truncate over-long prompts from the left
+            arr[i, : len(p)] = p
+            if len(p) < bucket:
+                # right-pad with the row's final token: with causal
+                # attention the FINAL position's logits (which seed the
+                # decode) see the true prompt plus harmless repeats
+                arr[i, len(p):] = p[-1]
+        self._key, sub = jax.random.split(self._key)
+        out = self.gpt.generate(
+            self.params, self.cfg, jnp.asarray(arr),
+            steps=self.max_new_tokens, temperature=self.temperature,
+            key=sub)
+        out_np = np.asarray(out)
+        self._stats["requests"] += n
+        self._stats["batches"] += 1
+        self._stats["generated_tokens"] += n * self.max_new_tokens
+        return [out_np[i, bucket: bucket + self.max_new_tokens].tolist()
+                for i in range(n)]
+
+
+def llm_deployment(preset: str = "gpt2-small",
+                   ray_actor_options: Optional[dict] = None,
+                   max_concurrent_queries: int = 64, **kwargs):
+    """A ready-to-run Application serving ``preset``:
+
+        import ray_memory_management_tpu.serve as serve
+        handle = serve.run(serve.llm_deployment("gpt2-small"))
+        serve.get_handle("LLM").remote({"tokens": [1, 2, 3]})
+
+    On a TPU host pass ``ray_actor_options={"num_tpus": 1}`` so the
+    replica takes a chip lease (TPU_VISIBLE_CHIPS isolation) and the
+    decode program runs on the chip."""
+    return deployment(
+        LLMServer, name="LLM", ray_actor_options=ray_actor_options,
+        max_concurrent_queries=max_concurrent_queries,
+    ).bind(preset=preset, **kwargs)
+
+
+__all__ = ["DynamicBatcher", "LLMServer", "llm_deployment"]

@@ -65,12 +65,52 @@ class TestRuntimeEnv:
         rmt.kill(a)
 
     def test_unsupported_keys_rejected(self, rmt_start_regular):
-        @rmt.remote(runtime_env={"pip": ["requests"]})
+        @rmt.remote(runtime_env={"conda": {"dependencies": ["x"]}})
         def nope():
             return 1
 
         with pytest.raises(ValueError):
             nope.remote()
+
+    def test_pip_env_installs_local_package(self, rmt_start_regular,
+                                            tmp_path):
+        """A task's pip runtime_env installs a package the driver lacks
+        (from a local source tree — this image has no network), and the
+        install is URI-cached so a second task reuses it."""
+        src = tmp_path / "pkgsrc"
+        (src / "rmt_pip_e2e").mkdir(parents=True)
+        (src / "setup.py").write_text(
+            "from setuptools import setup\n"
+            "setup(name='rmt-pip-e2e', version='0.1',"
+            " packages=['rmt_pip_e2e'])\n")
+        (src / "rmt_pip_e2e" / "__init__.py").write_text("ANSWER = 42\n")
+
+        with pytest.raises(ImportError):
+            import rmt_pip_e2e  # noqa: F401 — driver must lack it
+
+        env = {"pip": {"packages": [str(src)],
+                       "extra_args": ["--no-index",
+                                      "--no-build-isolation"]}}
+
+        @rmt.remote(runtime_env=env, max_retries=0)
+        def probe():
+            import rmt_pip_e2e
+
+            return rmt_pip_e2e.ANSWER
+
+        assert rmt.get(probe.remote(), timeout=300) == 42
+        # cached: second call must not rebuild (same content key)
+        assert rmt.get(probe.remote(), timeout=60) == 42
+
+        @rmt.remote(max_retries=0)
+        def still_absent():
+            try:
+                import rmt_pip_e2e  # noqa: F401
+            except ImportError:
+                return "clean"
+            return "leaked"
+
+        assert rmt.get(still_absent.remote(), timeout=60) == "clean"
 
 
 class TestClientMode:
