@@ -7,6 +7,7 @@ weights broadcast through the object store.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import CartPole, Env, make_env, register_env  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401

@@ -1,0 +1,326 @@
+"""DQN: off-policy Q-learning with a replay buffer on the learner.
+
+The reference's DQN (rllib/algorithms/dqn/dqn.py:394 training_step:
+store-to-replay, sample, TD update, periodic target-network sync;
+rllib/algorithms/dqn/dqn_tf_policy.py:237 the double-Q TD loss). TPU-first
+shape: the whole minibatch update — online forward, DOUBLE-Q target
+(argmax from the online net, value from the target net), Huber TD loss,
+Adam — is one jit'd XLA program; epsilon-greedy rollouts run on CPU
+actors; the replay buffer is host-side numpy (replay.py), feeding the
+chip one contiguous minibatch per step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import make_env
+from .models import mlp_apply, mlp_init, params_from_numpy, params_to_numpy
+from .replay import ReplayBuffer
+from .rollout_worker import WorkerSet
+
+NEXT_OBS = "next_obs"
+
+
+def q_init(rng, obs_dim: int, num_actions: int, hidden=(64, 64)):
+    return {"q": mlp_init(rng, [obs_dim, *hidden, num_actions])}
+
+
+def q_apply(params, obs):
+    return mlp_apply(params["q"], obs)
+
+
+def make_dqn_update(optimizer, gamma: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, target_params, obs, actions, rewards, next_obs,
+                dones):
+        q = q_apply(params, obs)
+        q_taken = jnp.take_along_axis(q, actions[:, None], axis=-1)[:, 0]
+        # double-Q: the ONLINE net picks the next action, the TARGET net
+        # scores it (dqn_tf_policy.py:237 double_q branch)
+        next_q_online = q_apply(params, next_obs)
+        next_a = jnp.argmax(next_q_online, axis=-1)
+        next_q_target = q_apply(target_params, next_obs)
+        next_val = jnp.take_along_axis(
+            next_q_target, next_a[:, None], axis=-1)[:, 0]
+        td_target = rewards + gamma * (1.0 - dones) * \
+            jax.lax.stop_gradient(next_val)
+        td_error = q_taken - td_target
+        loss = jnp.mean(optax.huber_loss(q_taken, td_target))
+        return loss, {
+            "mean_q": q_taken.mean(),
+            "mean_td_error": jnp.abs(td_error).mean(),
+        }
+
+    @jax.jit
+    def update(params, target_params, opt_state, obs, actions, rewards,
+               next_obs, dones):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, target_params, obs, actions, rewards, next_obs, dones)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["loss"] = loss
+        return params, opt_state, stats
+
+    return update
+
+
+class DQNRolloutWorker:
+    """Epsilon-greedy transition collector (the exploration half of the
+    reference's EpsilonGreedy rllib/utils/exploration/epsilon_greedy.py:26,
+    with the worker loop of rollout_worker.py:124). Emits raw
+    (obs, action, reward, next_obs, done) transitions — DQN's replay
+    consumes transitions, not GAE fragments."""
+
+    def __init__(self, env_spec, env_config: Optional[dict], hidden,
+                 seed: int):
+        import jax
+
+        from .. import _worker_context
+
+        if _worker_context.in_worker():
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        self.env = make_env(env_spec, env_config)
+        self.rng = np.random.default_rng(seed)
+        self.params = q_init(
+            jax.random.key(0), self.env.observation_dim,
+            self.env.num_actions, hidden)
+        self._obs = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self.episode_rewards: List[float] = []
+        self.episode_lengths: List[int] = []
+
+    def ready(self) -> str:
+        return "ok"
+
+    def set_weights(self, weights) -> None:
+        self.params = params_from_numpy(weights)
+
+    def sample(self, num_steps: int, epsilon: float) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        D = self.env.observation_dim
+        obs_buf = np.zeros((num_steps, D), np.float32)
+        next_buf = np.zeros((num_steps, D), np.float32)
+        act_buf = np.zeros(num_steps, np.int32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+        for t in range(num_steps):
+            if self.rng.random() < epsilon:
+                a = int(self.rng.integers(self.env.num_actions))
+            else:
+                q = q_apply(self.params, jnp.asarray(self._obs[None, :]))
+                a = int(np.asarray(q)[0].argmax())
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            obs_buf[t] = self._obs
+            act_buf[t] = a
+            rew_buf[t] = reward
+            # a time-limit truncation is NOT a terminal: the TD target
+            # must still bootstrap from next_obs (postprocessing.py
+            # treats truncations the same way)
+            done_buf[t] = float(terminated)
+            next_buf[t] = next_obs
+            self._episode_reward += reward
+            self._episode_len += 1
+            if terminated or truncated:
+                self.episode_rewards.append(self._episode_reward)
+                self.episode_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                next_obs = self.env.reset(
+                    seed=int(self.rng.integers(1 << 31)))
+            self._obs = next_obs
+        return {
+            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
+            NEXT_OBS: next_buf, sb.DONES: done_buf,
+        }
+
+    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
+        rewards = self.episode_rewards[-window:]
+        lengths = self.episode_lengths[-window:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else None,
+            "episode_len_mean": float(np.mean(lengths)) if lengths
+            else None,
+        }
+
+
+class _DQNWorkerSet(WorkerSet):
+    """WorkerSet over epsilon-greedy DQN collectors — inherits the
+    broadcast/stats/stop plumbing so the base Algorithm's
+    _sync_weights/_episode_metrics/cleanup apply unchanged."""
+
+    def __init__(self, env_spec, env_config, hidden, num_workers: int,
+                 seed: int):
+        cls = api.remote(DQNRolloutWorker)
+        self.remote_workers = [
+            cls.options(num_cpus=1).remote(
+                env_spec, env_config, hidden, seed + 1000 * (i + 1))
+            for i in range(num_workers)
+        ]
+        api.get([w.ready.remote() for w in self.remote_workers])
+
+    def sample(self, num_steps: int, epsilon: float = 0.0) -> List:
+        return [w.sample.remote(num_steps, epsilon)
+                for w in self.remote_workers]
+
+
+class DQN(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import optax
+
+        # Algorithm.setup builds actor-critic params + PG-shaped rollout
+        # workers; DQN needs a Q-net and epsilon-greedy transition
+        # collectors, so it wires its own (same env/seed plumbing).
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.np_rng = np.random.default_rng(seed)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        self.obs_dim = probe_env.observation_dim
+        self.num_actions = probe_env.num_actions
+        hidden = config.get("hidden", (64, 64))
+        self.params = q_init(jax.random.key(seed), self.obs_dim,
+                             self.num_actions, hidden)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.gamma = config.get("gamma", 0.99)
+        self.optimizer = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_dqn_update(self.optimizer, self.gamma)
+        self.replay = ReplayBuffer(
+            config.get("replay_buffer_capacity", 50_000), seed=seed)
+        self.learning_starts = config.get("learning_starts", 1_000)
+        self.train_batch_size = config.get("train_batch_size", 64)
+        self.target_update_freq = config.get(
+            "target_network_update_freq", 500)
+        self.updates_per_step = config.get("updates_per_step", 32)
+        self.eps_initial = config.get("epsilon_initial", 1.0)
+        self.eps_final = config.get("epsilon_final", 0.02)
+        self.eps_timesteps = config.get("epsilon_timesteps", 10_000)
+        self._updates_done = 0
+        self._timesteps_total = 0
+
+        n_workers = config.get("num_rollout_workers", 0)
+        self.workers = None
+        self.local_worker = None
+        if n_workers > 0:
+            self.workers = _DQNWorkerSet(
+                config["env_spec"], config.get("env_config"), hidden,
+                n_workers, seed)
+        else:
+            self.local_worker = DQNRolloutWorker(
+                config["env_spec"], config.get("env_config"), hidden, seed)
+
+    # -- exploration schedule --------------------------------------------------
+    def _epsilon(self) -> float:
+        frac = min(1.0, self._timesteps_total / max(1, self.eps_timesteps))
+        return self.eps_initial + frac * (self.eps_final - self.eps_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        fragment = self.cfg.get("rollout_fragment_length", 64)
+        eps = self._epsilon()
+        self._sync_weights()
+        if self.workers is not None:
+            batches = api.get(self.workers.sample(fragment, eps))
+        else:
+            batches = [self.local_worker.sample(fragment, eps)]
+        n = 0
+        for b in batches:
+            self.replay.add_batch(b)
+            n += len(b[sb.ACTIONS])
+        self._timesteps_total += n
+        sample_time = time.time() - t0
+
+        stats: Dict[str, Any] = {}
+        t1 = time.time()
+        if len(self.replay) >= self.learning_starts:
+            for _ in range(self.updates_per_step):
+                mb = self.replay.sample(self.train_batch_size)
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    jnp.asarray(mb[sb.OBS]), jnp.asarray(mb[sb.ACTIONS]),
+                    jnp.asarray(mb[sb.REWARDS]),
+                    jnp.asarray(mb[NEXT_OBS]),
+                    jnp.asarray(mb[sb.DONES]))
+                self._updates_done += 1
+                if self._updates_done % self.target_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+        learn_time = time.time() - t1
+
+        out = {k: float(v) for k, v in stats.items()}
+        out.update({
+            "num_env_steps_sampled": n,
+            "replay_size": len(self.replay),
+            "epsilon": eps,
+            "num_updates": self._updates_done,
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+        })
+        return out
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+
+        q = q_apply(self.params, jnp.asarray(obs[None, :]))
+        return int(np.asarray(q)[0].argmax())
+
+    def _save_extra_state(self):
+        return {
+            "opt_state": params_to_numpy(self.opt_state),
+            "target_params": params_to_numpy(self.target_params),
+            "updates_done": self._updates_done,
+        }
+
+    def _load_extra_state(self, state) -> None:
+        if not state:
+            return
+        if "opt_state" in state:
+            self.opt_state = params_from_numpy(state["opt_state"])
+        if "target_params" in state:
+            self.target_params = params_from_numpy(state["target_params"])
+        self._updates_done = state.get("updates_done", 0)
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.extra.update({
+            "replay_buffer_capacity": 50_000, "learning_starts": 1_000,
+            "target_network_update_freq": 500, "updates_per_step": 32,
+            "epsilon_initial": 1.0, "epsilon_final": 0.02,
+            "epsilon_timesteps": 10_000,
+        })
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 target_network_update_freq=None, updates_per_step=None,
+                 epsilon_initial=None, epsilon_final=None,
+                 epsilon_timesteps=None, **kwargs) -> "DQNConfig":
+        super().training(**kwargs)
+        for k, v in (
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("learning_starts", learning_starts),
+                ("target_network_update_freq", target_network_update_freq),
+                ("updates_per_step", updates_per_step),
+                ("epsilon_initial", epsilon_initial),
+                ("epsilon_final", epsilon_final),
+                ("epsilon_timesteps", epsilon_timesteps)):
+            if v is not None:
+                self.extra[k] = v
+        return self

@@ -128,8 +128,20 @@ def _pip_env_site_packages(spec) -> str:
     existing interpreter: the base environment stays visible and the env
     applies/rolls back as a single sys.path entry."""
     packages, extra_args = _pip_spec(spec)
+    # content-key local source trees: a path-string key would serve stale
+    # builds forever after the user edits the package (uri_cache.py keys
+    # working_dir by content the same way)
+    key_parts = []
+    for pkg in sorted(packages):
+        if os.path.isdir(pkg):
+            key_parts.append(f"{pkg}@{_dir_digest(pkg)}")
+        elif os.path.isfile(pkg):
+            st = os.stat(pkg)
+            key_parts.append(f"{pkg}@{st.st_size}:{st.st_mtime_ns}")
+        else:
+            key_parts.append(pkg)
     key = hashlib.sha256(
-        json.dumps([sorted(packages), extra_args]).encode()).hexdigest()[:16]
+        json.dumps([key_parts, extra_args]).encode()).hexdigest()[:16]
     dest = os.path.join(_PIP_CACHE, key)
     marker = os.path.join(dest, ".rmt_ready")
     if not os.path.exists(marker):

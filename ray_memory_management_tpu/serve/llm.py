@@ -157,8 +157,14 @@ class LLMServer:
     def reconfigure(self, user_config: Optional[dict]) -> None:
         if not user_config:
             return
-        self.max_new_tokens = int(user_config.get(
+        new_tokens = int(user_config.get(
             "max_new_tokens", self.max_new_tokens))
+        if new_tokens + self.pad_multiple > self.cfg.max_seq:
+            raise ValueError(
+                f"max_new_tokens={new_tokens} leaves no room for a "
+                f"{self.pad_multiple}-token prompt bucket within "
+                f"max_seq={self.cfg.max_seq}")
+        self.max_new_tokens = new_tokens
         self.temperature = float(user_config.get(
             "temperature", self.temperature))
 

@@ -30,6 +30,30 @@ class TrainingFailedError(RmtError):
     pass
 
 
+def partition_chips_for_host(n_chips: int, n_workers: int,
+                             exclude: Optional[set] = None) -> List[str]:
+    """Split a host's chips into ``n_workers`` DISJOINT contiguous slices
+    covering every available chip (sizes differ by at most one when the
+    count does not divide evenly). One process per host is the preferred
+    TPU layout (SURVEY §7); when a gang does co-locate processes, each
+    must own its slice outright — TPU runtimes cannot time-share a chip
+    between jax.distributed processes. ``exclude`` removes chips already
+    leased to sibling workers through the scheduler."""
+    chips = [c for c in range(n_chips) if not exclude or c not in exclude]
+    if n_workers > len(chips):
+        raise TrainingFailedError(
+            f"{n_workers} xla-mode workers share a host with only "
+            f"{len(chips)} free chips; use at most one worker per chip "
+            "(or one worker per host controlling all its chips)")
+    base, extra = divmod(len(chips), n_workers)
+    out, pos = [], 0
+    for i in range(n_workers):
+        take = base + (1 if i < extra else 0)
+        out.append(",".join(str(c) for c in chips[pos:pos + take]))
+        pos += take
+    return out
+
+
 class _TrainWorkerImpl:
     """The per-worker actor (RayTrainWorker analog, worker_group.py:335)."""
 
@@ -47,6 +71,29 @@ class _TrainWorkerImpl:
         from ..collective import init_collective_group
 
         init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+    def _rmt_host_info(self) -> dict:
+        """Where this worker runs and what chips it already leased — the
+        input to the head's per-host chip partitioning."""
+        import os
+
+        return {
+            "node_id": os.environ.get("RMT_NODE_ID", ""),
+            "visible_chips": os.environ.get("TPU_VISIBLE_CHIPS"),
+        }
+
+    def _rmt_set_visible_chips(self, chips_csv: str) -> bool:
+        """Pin this worker to a disjoint chip subset BEFORE any jax backend
+        initializes (the torch _share_cuda_visible_devices analog,
+        train/backend_executor.py:195 + torch/config.py:108-156 — except
+        TPU processes must own DISJOINT chips, so the head partitions
+        rather than shares)."""
+        import os
+
+        os.environ["TPU_VISIBLE_CHIPS"] = chips_csv
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            del os.environ["JAX_PLATFORMS"]
         return True
 
     def _rmt_pick_coordinator(self) -> str:
@@ -194,11 +241,57 @@ class WorkerGroup:
             backend="objstore", group_name=self.group_name,
         )
 
+    def partition_chips(self) -> None:
+        """Give xla-mode workers sharing a host DISJOINT TPU_VISIBLE_CHIPS.
+
+        Workers that leased chips through the scheduler (num_tpus>0)
+        already hold disjoint sets; this covers the bare-CPU-request case
+        where two xla workers on one TPU host would otherwise both claim
+        every local chip when jax.distributed initializes (VERDICT r2
+        item 7; reference analog _share_cuda_visible_devices,
+        train/backend_executor.py:195)."""
+        from ..state.api import list_nodes
+
+        infos = api.get([a._rmt_host_info.remote() for a in self.actors],
+                        timeout=120)
+        totals = {row["node_id"]: int(
+            row["resources_total"].get("TPU", 0) or 0)
+            for row in list_nodes()}
+        by_node: Dict[str, List[int]] = {}
+        for rank, info in enumerate(infos):
+            by_node.setdefault(info["node_id"], []).append(rank)
+        calls = []
+        for node_id, ranks in by_node.items():
+            n_chips = totals.get(node_id, 0)
+            if n_chips <= 0:
+                continue  # CPU-only host: nothing to partition
+            # workers whose scheduler lease already pinned chips keep
+            # them; the UNLEASED siblings must still be fenced off those
+            # chips, or their jax.distributed init claims the whole host
+            leased_chips: set = set()
+            unleased: List[int] = []
+            for r in ranks:
+                csv = infos[r]["visible_chips"]
+                if csv:
+                    leased_chips.update(int(c) for c in csv.split(","))
+                else:
+                    unleased.append(r)
+            if not unleased:
+                continue
+            slices = partition_chips_for_host(n_chips, len(unleased),
+                                              exclude=leased_chips)
+            for csv, rank in zip(slices, sorted(unleased)):
+                calls.append(
+                    self.actors[rank]._rmt_set_visible_chips.remote(csv))
+        if calls:
+            api.get(calls, timeout=120)
+
     def setup_xla_world(self) -> int:
         """Cross-worker XLA mode: every worker process joins one
         jax.distributed world so the user loop jits over ONE global mesh —
         gradients sync through XLA collectives (ICI/DCN), never the object
         plane. Returns the global device count."""
+        self.partition_chips()
         coordinator = api.get(
             self.actors[0]._rmt_pick_coordinator.remote(), timeout=120)
         counts = api.get(
