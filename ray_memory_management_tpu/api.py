@@ -123,7 +123,9 @@ class RemoteFunction:
         payload["args"] = enc_args
         payload["kwargs"] = enc_kwargs
         return_ids = _backend().submit_task(payload)
-        refs = [ObjectRef(oid, _owner()) for oid in return_ids]
+        # adopt: submit pre-registered one handle ref per return id
+        refs = [ObjectRef(oid, _owner(), adopt=_owner() is not None)
+                for oid in return_ids]
         return refs[0] if len(refs) == 1 else refs
 
     def __call__(self, *args, **kwargs):
@@ -192,7 +194,9 @@ class ActorMethod:
             "num_returns": self._num_returns,
         }
         return_ids = _backend().submit_actor_task(payload)
-        refs = [ObjectRef(oid, _owner()) for oid in return_ids]
+        # adopt: submit pre-registered one handle ref per return id
+        refs = [ObjectRef(oid, _owner(), adopt=_owner() is not None)
+                for oid in return_ids]
         return refs[0] if len(refs) == 1 else refs
 
 

@@ -77,9 +77,11 @@ class ClusterServer:
         try:
             mtype = msg["type"]
             if mtype == "submit_task":
-                reply["return_ids"] = rt.submit_task(msg["payload"])
+                reply["return_ids"] = rt.submit_task(
+                    msg["payload"], adopt_returns=False)
             elif mtype == "submit_actor_task":
-                reply["return_ids"] = rt.submit_actor_task(msg["payload"])
+                reply["return_ids"] = rt.submit_actor_task(
+                    msg["payload"], adopt_returns=False)
             elif mtype == "create_actor":
                 reply["actor_id"] = rt.create_actor(msg["payload"])
             elif mtype == "get_objects":

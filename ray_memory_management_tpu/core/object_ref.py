@@ -16,10 +16,14 @@ from typing import Optional
 class ObjectRef:
     __slots__ = ("_id", "_owner", "__weakref__")
 
-    def __init__(self, object_id: bytes, owner=None):
+    def __init__(self, object_id: bytes, owner=None, adopt: bool = False):
+        """``adopt=True`` takes over a reference the owner ALREADY holds
+        (submit_task pre-registers one per return id so a task finishing
+        before the driver wraps its ids cannot see a refcount of zero)
+        instead of adding a new one."""
         self._id = object_id
         self._owner = owner
-        if owner is not None:
+        if owner is not None and not adopt:
             owner.add_local_ref(object_id)
 
     def binary(self) -> bytes:

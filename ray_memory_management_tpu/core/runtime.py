@@ -67,13 +67,24 @@ class _SendChannel:
 
 
 class _TaskRecord:
-    __slots__ = ("spec", "retries_left", "state", "payload")
+    __slots__ = ("spec", "retries_left", "state", "payload",
+                 "args_released", "gc_returns")
 
-    def __init__(self, spec: TaskSpec, payload: dict, retries_left: int):
+    def __init__(self, spec: TaskSpec, payload: dict, retries_left: int,
+                 gc_returns: bool = True):
         self.spec = spec
         self.payload = payload  # original submission payload, for resubmit
         self.retries_left = retries_left
         self.state = "PENDING"
+        # the task holds a reference on each of its ref args until it
+        # reaches a terminal state (reference_count.h task-argument refs);
+        # this flag makes the release idempotent across the several
+        # terminal paths (done / permanent fail / cancel)
+        self.args_released = False
+        # False for worker/client submissions: their return handles are
+        # bare (no distributed refcount), so neither their values nor
+        # their metadata are ever GC'd — the pre-refactor behavior
+        self.gc_returns = gc_returns
 
 
 class _ActorInfo:
@@ -125,6 +136,15 @@ class Runtime:
         self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids
         self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)
         self._pending_schedule: deque = deque()
+        # lineage pinning (reference_count.h lineage refcounting): how many
+        # RETAINED task records list this oid as a ref arg. A producer's
+        # record/lineage can only be pruned when no downstream record still
+        # needs it for transitive reconstruction.
+        self._lineage_dependents: Dict[bytes, int] = defaultdict(int)
+        # bounded history of GC'd tasks so observability survives pruning
+        # (the reference's GcsTaskManager keeps a capped task-event log
+        # for the same reason); entries are tiny summary dicts
+        self.task_history: deque = deque(maxlen=10_000)
         # dep-ready tasks awaiting scheduling, drained in BATCHES by the
         # router's pump: per-task inline scheduling cost ~7 lock/notify
         # round-trips; batching pays them once per burst (the reference
@@ -772,7 +792,8 @@ class Runtime:
             pass
 
     # ------------------------------------------------------- task submission
-    def submit_task(self, payload: dict) -> List[bytes]:
+    def submit_task(self, payload: dict,
+                    adopt_returns: bool = True) -> List[bytes]:
         task_id = TaskID.for_task(self.job_id)
         num_returns = payload.get("num_returns", 1)
         return_ids = [
@@ -796,12 +817,23 @@ class Runtime:
             retry_exceptions=payload.get("retry_exceptions", False),
             runtime_env=payload.get("runtime_env"),
         )
-        rec = _TaskRecord(spec, payload, spec.max_retries)
+        rec = _TaskRecord(spec, payload, spec.max_retries,
+                          gc_returns=adopt_returns)
         with self._lock:
             self.tasks[spec.task_id] = rec
             for oid in return_ids:
                 self.futures[oid] = Future()
                 self.lineage[oid] = spec.task_id
+                if adopt_returns:
+                    # pre-registered handle ref, ADOPTED by the caller's
+                    # ObjectRef: without it a fast task completing before
+                    # the wrap would see refcount zero and GC its result
+                    self.local_refs[oid] += 1
+            # the pending task keeps its ref args (and their lineage)
+            # alive even if the caller drops every handle before it runs
+            for oid in self._ref_deps(spec):
+                self.local_refs[oid] += 1
+                self._lineage_dependents[oid] += 1
             nudge = self._queue_when_deps_ready_locked(spec)
         if nudge:
             self._wakeup()
@@ -873,6 +905,17 @@ class Runtime:
         if spec.placement is not None and self.pg_manager is not None:
             self.pg_manager.release_key(spec.task_id)
 
+    def _release_task_args(self, spec: TaskSpec) -> None:
+        """Drop the references a task held on its ref args (idempotent;
+        called from every terminal path)."""
+        with self._lock:
+            rec = self.tasks.get(spec.task_id)
+            if rec is None or rec.args_released:
+                return
+            rec.args_released = True
+        for oid in self._ref_deps(spec):
+            self.remove_local_ref(oid)
+
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
         self._release_pg_allocation(spec)
         with self._lock:
@@ -883,6 +926,7 @@ class Runtime:
             rec = self.tasks.get(spec.task_id)
             if rec:
                 rec.state = "FAILED"
+        self._release_task_args(spec)
 
     def _schedule(self, spec: TaskSpec, pump: bool = True) -> None:
         if spec.task_id in self._cancelled:
@@ -1221,8 +1265,9 @@ class Runtime:
         if not simple:
             return
         nudge = False
+        to_free: List[bytes] = []
         with self._lock:
-            for m, _spec in simple:
+            for m, spec in simple:
                 for oid, kind, data in m["returns"]:
                     if kind == "v":
                         self.memory_store[oid] = data
@@ -1239,6 +1284,28 @@ class Runtime:
                 rec = self.tasks.get(m["task_id"])
                 if rec:
                     rec.state = "FINISHED"
+                # arg release + fire-and-forget GC stay inside the batch
+                # lock (per-task locking was the completion side's
+                # dominant cost); only the zero-ref free_object calls run
+                # outside it
+                if spec is not None and rec is not None \
+                        and not rec.args_released:
+                    rec.args_released = True
+                    for oid in self._ref_deps(spec):
+                        self.local_refs[oid] -= 1
+                        if self.local_refs[oid] <= 0:
+                            del self.local_refs[oid]
+                            to_free.append(oid)
+                if spec is not None and rec is not None and rec.gc_returns:
+                    # returns whose every handle was dropped BEFORE the
+                    # task finished have no refcount-zero transition left
+                    # to trigger GC — sweep them now (driver-owned refs
+                    # only: worker/client return handles are bare)
+                    to_free.extend(
+                        roid for roid in spec.return_ids
+                        if roid not in self.local_refs)
+        for oid in to_free:
+            self.free_object(oid)
         if nudge:
             self._wakeup()
 
@@ -1377,7 +1444,8 @@ class Runtime:
         for spec in flush:
             self._dispatch_actor_task(info, spec)
 
-    def submit_actor_task(self, payload: dict) -> List[bytes]:
+    def submit_actor_task(self, payload: dict,
+                          adopt_returns: bool = True) -> List[bytes]:
         actor_id = payload["actor_id"]
         with self._lock:
             info = self.actors.get(actor_id)
@@ -1402,11 +1470,20 @@ class Runtime:
             seq=next(info.seq),
             max_retries=info.spec.max_task_retries,
         )
-        rec = _TaskRecord(spec, payload, info.spec.max_task_retries)
+        rec = _TaskRecord(spec, payload, info.spec.max_task_retries,
+                          gc_returns=adopt_returns)
         with self._lock:
             self.tasks[spec.task_id] = rec
             for oid in return_ids:
                 self.futures[oid] = Future()
+                # lineage here serves record GC, not reconstruction —
+                # _recover_object refuses actor results explicitly
+                self.lineage[oid] = spec.task_id
+                if adopt_returns:
+                    self.local_refs[oid] += 1
+            for oid in self._ref_deps(spec):
+                self.local_refs[oid] += 1
+                self._lineage_dependents[oid] += 1
         state = info.record.state
         if state == ACTOR_DEAD:
             self._fail_task(spec, ActorDiedError(
@@ -1915,6 +1992,12 @@ class Runtime:
             rec = self.tasks.get(task_id) if task_id else None
         if rec is None:
             raise ObjectLostError(oid.hex(), "no lineage recorded")
+        if rec.spec.is_actor_task:
+            # re-running an actor method against mutated actor state is
+            # not reconstruction (the reference likewise only rebuilds
+            # task lineage; actor results need max_task_retries)
+            raise ObjectLostError(
+                oid.hex(), "actor task result is not reconstructable")
         spec = rec.spec
         with self._lock:
             # reset return futures so dependents re-wait
@@ -1923,6 +2006,13 @@ class Runtime:
                 if fut is None or fut.done():
                     self.futures[roid] = Future()
             rec.state = "RESUBMITTED"
+            # re-acquire the arg pins the first completion released: the
+            # re-execution (and the completion sweep that follows it)
+            # must see the args — and its own result — as referenced
+            if rec.args_released:
+                rec.args_released = False
+                for aoid in self._ref_deps(spec):
+                    self.local_refs[aoid] += 1
         self._resolve_deps_then_schedule(spec)
         for roid in spec.return_ids:
             with self._lock:
@@ -2009,11 +2099,70 @@ class Runtime:
             del self.local_refs[oid]
         self.free_object(oid)
 
+    def _try_prune_record_locked(self, task_id: bytes) -> None:
+        """With self._lock held: prune a terminal task's record, futures,
+        and lineage edges once nothing can need them again — no live
+        handle on any return, no settled-future waiter, and no RETAINED
+        downstream record that could demand transitive reconstruction
+        (lineage pinning, reference_count.h). Pruning a record releases
+        its lineage pins on its OWN args, which can cascade upstream.
+        Without this GC the head retains O(all tasks ever) records
+        (many_actors.json records head peak memory for this reason)."""
+        stack = [task_id]
+        while stack:
+            tid = stack.pop()
+            rec = self.tasks.get(tid)
+            if (rec is None or not rec.gc_returns
+                    or rec.state not in ("FINISHED", "FAILED")
+                    or not rec.args_released):
+                continue
+            rets = rec.spec.return_ids
+            if any(r in self.local_refs for r in rets):
+                continue  # a handle (or a pending task's arg pin) lives
+            if any(self._lineage_dependents.get(r, 0) > 0 for r in rets):
+                continue  # a retained downstream record may reconstruct
+            if any(r in self.futures and not self.futures[r].done()
+                   for r in rets):
+                continue  # an unresolved future may have waiters
+            for r in rets:
+                self.futures.pop(r, None)
+                self.lineage.pop(r, None)
+                self.memory_store.pop(r, None)
+            self.task_history.append({
+                "task_id": tid.hex(),
+                "name": rec.spec.name,
+                "state": rec.state,
+                "num_returns": rec.spec.num_returns,
+                "retries_left": rec.retries_left,
+                "is_actor_task": rec.spec.is_actor_task,
+            })
+            del self.tasks[tid]
+            for a in self._ref_deps(rec.spec):
+                n = self._lineage_dependents.get(a, 0) - 1
+                if n > 0:
+                    self._lineage_dependents[a] = n
+                else:
+                    self._lineage_dependents.pop(a, None)
+                    # the arg's producer may have been waiting on us
+                    ptid = self.lineage.get(a)
+                    if ptid is not None and a not in self.local_refs:
+                        stack.append(ptid)
+
     def free_object(self, oid: bytes) -> None:
-        """Drop an object's value everywhere (ray.internal.free analog)."""
+        """Drop an object's value everywhere (ray.internal.free analog),
+        then try to prune the producing task's metadata (see
+        _try_prune_record_locked)."""
         with self._lock:
-            self.memory_store.pop(oid, None)
             loc = self._device_locations.pop(oid, None)
+            self.memory_store.pop(oid, None)  # the value is dead either way
+            task_id = self.lineage.get(oid)
+            if task_id is not None:
+                self._try_prune_record_locked(task_id)
+            else:
+                # a put object: no lineage, just the settled future
+                fut = self.futures.get(oid)
+                if fut is not None and fut.done():
+                    self.futures.pop(oid, None)
         if loc == "driver":
             self.device_store.delete(oid)
         elif loc is not None:
@@ -2031,9 +2180,11 @@ class Runtime:
         try:
             mtype = msg["type"]
             if mtype == "submit_task":
-                reply["return_ids"] = self.submit_task(msg["payload"])
+                reply["return_ids"] = self.submit_task(
+                    msg["payload"], adopt_returns=False)
             elif mtype == "submit_actor_task":
-                reply["return_ids"] = self.submit_actor_task(msg["payload"])
+                reply["return_ids"] = self.submit_actor_task(
+                    msg["payload"], adopt_returns=False)
             elif mtype == "create_actor":
                 reply["actor_id"] = self.create_actor(msg["payload"])
             elif mtype == "get_objects":

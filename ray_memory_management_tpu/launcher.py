@@ -152,6 +152,96 @@ class SSHProvider(NodeProvider):
             pass
 
 
+class GCETPUProvider(NodeProvider):
+    """GCE TPU-VM nodes through the ``gcloud`` CLI (the reference's GCP
+    node provider, autoscaler/_private/gcp/node_provider.py, recast for
+    TPU VMs: ``gcloud compute tpus tpu-vm create/ssh/delete``).
+
+    Worker spec fields: ``name`` (instance name; generated if absent),
+    ``accelerator_type`` (e.g. v5litepod-8), ``version`` (runtime image),
+    plus the usual num_cpus/num_tpus. Provider config: ``project``,
+    ``zone``, optional ``gcloud_command`` (tests substitute a recording
+    shim), ``remote_python``, and ``bootstrap`` (shell prefix run before
+    the agent, e.g. a pip install of this package). For multi-host pods
+    the agent starts on EVERY host (``--worker=all``) — each host joins
+    the head as its own node, which is exactly the one-agent-per-host
+    model the multi-host plane expects."""
+
+    def __init__(self, provider_cfg: Dict[str, Any], log_dir: str = ""):
+        self.gcloud = provider_cfg.get("gcloud_command", "gcloud")
+        self.project = provider_cfg.get("project", "")
+        self.zone = provider_cfg.get("zone", "")
+        self.python = provider_cfg.get("remote_python", "python3")
+        self.bootstrap = provider_cfg.get("bootstrap", "")
+        self.log_dir = log_dir
+        self._count = 0
+
+    def _scope(self) -> List[str]:
+        out = []
+        if self.project:
+            out += ["--project", self.project]
+        if self.zone:
+            out += ["--zone", self.zone]
+        return out
+
+    def launch_worker(self, spec, head_addr, authkey_hex):
+        import threading
+
+        self._count += 1
+        name = spec.get("name", f"rmt-worker-{self._count}")
+        create = [
+            self.gcloud, "compute", "tpus", "tpu-vm", "create", name,
+            *self._scope(),
+            "--accelerator-type", spec.get("accelerator_type",
+                                           "v5litepod-8"),
+            "--version", spec.get("version", "tpu-ubuntu2204-base"),
+        ]
+        agent_cmd = (
+            f"{self.python} -m ray_memory_management_tpu.core.node_agent "
+            f"--address {head_addr} --authkey {authkey_hex} "
+            f"--num-cpus {spec.get('num_cpus', 4)} "
+            f"--num-tpus {spec.get('num_tpus', 0)}"
+        )
+        if self.bootstrap:
+            agent_cmd = f"{self.bootstrap} && {agent_cmd}"
+        ssh = [
+            self.gcloud, "compute", "tpus", "tpu-vm", "ssh", name,
+            *self._scope(), "--worker=all", "--command", agent_cmd,
+        ]
+        record = {"kind": "gce-tpu", "pid": None, "name": name,
+                  "error": None}
+
+        def provision():
+            # create takes MINUTES per TPU VM: run it off the caller so a
+            # multi-worker `up` provisions the whole pod concurrently
+            # (nodes join the head as their agents come up)
+            rc = subprocess.run(create, capture_output=True, text=True,
+                                timeout=1800)
+            if rc.returncode != 0:
+                record["error"] = rc.stderr.strip()[-500:]
+                return
+            proc = subprocess.Popen(
+                ssh, close_fds=True,
+                **_daemon_log(self.log_dir, f"gce-{name}"))
+            record["pid"] = proc.pid
+
+        threading.Thread(target=provision, daemon=True,
+                         name=f"gce-up-{name}").start()
+        return record
+
+    def terminate_worker(self, record):
+        pid = record.get("pid")
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)  # drop the ssh channel
+            except (ProcessLookupError, PermissionError):
+                pass
+        subprocess.run(
+            [self.gcloud, "compute", "tpus", "tpu-vm", "delete",
+             record["name"], *self._scope(), "--quiet"],
+            capture_output=True, text=True, timeout=1800)
+
+
 def _daemon_log(log_dir: str, tag: str) -> Dict[str, Any]:
     """Popen kwargs detaching a daemon's stdio from the caller: inheriting
     the CLI's pipes would keep e.g. ``subprocess.run(capture_output=True)``
@@ -173,6 +263,8 @@ def make_provider(provider_cfg: Dict[str, Any],
         return SubprocessProvider(log_dir)
     if kind == "ssh":
         return SSHProvider(provider_cfg, log_dir)
+    if kind in ("gce", "gce-tpu"):
+        return GCETPUProvider(provider_cfg, log_dir)
     raise ValueError(f"unknown provider type: {kind}")
 
 

@@ -81,9 +81,11 @@ def list_actors(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
 
 def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     rt = _runtime()
-    rows = []
     with rt._lock:
         records = list(rt.tasks.items())
+        # GC'd tasks stay observable through the bounded history
+        # (runtime.task_history; the reference's GcsTaskManager log)
+        rows = list(rt.task_history)
     for task_id, rec in records:
         rows.append({
             "task_id": task_id.hex(),

@@ -127,3 +127,36 @@ def test_infeasible_task_fails(rmt_start_regular):
 
     with pytest.raises(rmt.TaskError, match="infeasible"):
         rmt.get(huge.remote(), timeout=10)
+
+
+def test_task_metadata_pruned_after_refs_released(rmt_start_regular):
+    """Finished-task records and futures must not accumulate forever on
+    the head (the owner GC's its reference table in the reference; head
+    peak memory is a recorded scalability metric)."""
+    rt = rmt_start_regular
+
+    @rmt.remote(max_retries=0)
+    def noop():
+        return 1
+
+    refs = [noop.remote() for _ in range(300)]
+    assert sum(rmt.get(refs, timeout=120)) == 300
+    with rt._lock:
+        tasks_before = len(rt.tasks)
+        futures_before = len(rt.futures)
+    assert tasks_before >= 300
+    del refs  # drop the last ObjectRefs: refcounts hit zero
+    import gc
+    import time
+
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with rt._lock:
+            if len(rt.tasks) <= tasks_before - 300:
+                break
+        time.sleep(0.1)
+    with rt._lock:
+        assert len(rt.tasks) <= tasks_before - 300
+        assert len(rt.futures) <= futures_before - 300
+        assert len(rt.lineage) <= 5

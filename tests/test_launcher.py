@@ -103,3 +103,48 @@ def test_ssh_provider_command_shape(tmp_path, monkeypatch):
         assert "node_agent" in line
     finally:
         provider.terminate_worker(rec)
+
+
+def test_gce_tpu_provider_command_shape(tmp_path, monkeypatch):
+    """The GCE provider drives gcloud tpu-vm create/ssh/delete; a shim
+    records every invocation instead of touching GCP."""
+    monkeypatch.setattr(launcher, "STATE_DIR", str(tmp_path / "state"))
+    shim = tmp_path / "fake_gcloud.sh"
+    log = tmp_path / "gcloud.log"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$@\" >> {log}\n"
+        "case \"$*\" in *\" ssh \"*) sleep 600;; esac\n")
+    shim.chmod(0o755)
+    provider = launcher.GCETPUProvider({
+        "type": "gce-tpu", "gcloud_command": str(shim),
+        "project": "my-proj", "zone": "us-central2-b",
+        "bootstrap": "pip install rmt",
+    })
+    rec = provider.launch_worker(
+        {"name": "podnode", "accelerator_type": "v5litepod-8",
+         "num_cpus": 8, "num_tpus": 8},
+        "10.0.0.1:7777", "abcd")
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                not log.exists() or log.read_text().count("\n") < 2):
+            time.sleep(0.05)
+        lines = log.read_text().strip().splitlines()
+        create = next(ln for ln in lines if " create " in ln)
+        assert "compute tpus tpu-vm create podnode" in create
+        assert "--project my-proj" in create
+        assert "--accelerator-type v5litepod-8" in create
+        ssh = next(ln for ln in lines if " ssh " in ln)
+        assert "--worker=all" in ssh
+        assert "pip install rmt &&" in ssh
+        assert "--address 10.0.0.1:7777" in ssh
+        assert "node_agent" in ssh
+    finally:
+        provider.terminate_worker(rec)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            "delete" not in log.read_text():
+        time.sleep(0.05)
+    assert any("delete podnode" in ln and "--quiet" in ln
+               for ln in log.read_text().splitlines())

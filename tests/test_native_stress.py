@@ -16,3 +16,26 @@ def test_native_stress_passes():
     assert out.returncode == 0, out.stdout + out.stderr
     assert "STRESS OK" in out.stdout
     subprocess.run(["make", "clean"], cwd=NATIVE_DIR, capture_output=True)
+
+
+def test_native_stress_under_tsan():
+    """Race detection for the multi-threaded allocator: the stress test
+    under ThreadSanitizer (the reference's --config=tsan bazel run,
+    .bazelrc:92-106). Any data race fails the run (halt_on_error)."""
+    out = subprocess.run(
+        ["make", "tsan"], cwd=NATIVE_DIR,
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "STRESS OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stdout + out.stderr
+    subprocess.run(["make", "clean"], cwd=NATIVE_DIR, capture_output=True)
+
+
+def test_native_stress_under_asan():
+    """Heap/UB coverage: the stress test under AddressSanitizer+UBSan."""
+    out = subprocess.run(
+        ["make", "asan"], cwd=NATIVE_DIR,
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "STRESS OK" in out.stdout
+    subprocess.run(["make", "clean"], cwd=NATIVE_DIR, capture_output=True)
