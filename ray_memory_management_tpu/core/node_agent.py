@@ -57,26 +57,9 @@ def _reap_stale_agent_stores() -> None:
     """A SIGKILLed agent cannot unlink its shm store; reclaim segments whose
     owning pid (embedded in the name) is gone. Runs at agent start so a
     crash-looping host converges instead of filling /dev/shm."""
-    try:
-        names = os.listdir("/dev/shm")
-    except OSError:
-        return
-    for name in names:
-        if not name.startswith("rmtA_"):
-            continue
-        try:
-            pid = int(name.split("_")[1])
-        except (IndexError, ValueError):
-            continue
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
-            try:
-                os.unlink(f"/dev/shm/{name}")
-            except OSError:
-                pass
-        except PermissionError:
-            pass  # pid alive under another uid
+    from ..native import reap_stale_stores
+
+    reap_stale_stores("rmtA_")
 
 
 class NodeAgent:
@@ -380,6 +363,17 @@ class NodeAgent:
 
         self._fetch_pool.submit(run)
 
+    def _obj_spill(self, msg: dict) -> None:
+        """Head-requested spill: a worker's direct shm put needs room (the
+        raylet-spills-for-plasma-creates path; policy lives in
+        NodeObjectStore.make_room, shared with the head's local stores)."""
+        try:
+            self.store.make_room(int(msg["bytes"]))
+            err = None
+        except Exception as e:  # noqa: BLE001
+            err = repr(e)
+        self._send({"type": "spill_ack", "req": msg["req"], "error": err})
+
     def _obj_ensure(self, msg: dict) -> None:
         """Restore the object(s) into shm (if spilled) and pin briefly so
         the requesting worker's direct shm read cannot race a re-spill
@@ -405,6 +399,7 @@ class NodeAgent:
             "obj_seal": self._obj_seal,
             "obj_pull": self._obj_pull,
             "obj_ensure": self._obj_ensure,
+            "obj_spill": self._obj_spill,
         }
         while not self._stop.is_set():
             with self._obj_cond:
@@ -458,7 +453,7 @@ class NodeAgent:
             elif t == "obj_fetch":
                 self._obj_fetch(msg)  # non-blocking: pool submit
             elif t in ("obj_push", "obj_chunk", "obj_seal", "obj_pull",
-                       "obj_ensure"):
+                       "obj_ensure", "obj_spill"):
                 nbytes = len(msg["data"]) if t == "obj_chunk" else 0
                 with self._obj_cond:
                     # backpressure: park (stop reading the socket) rather

@@ -164,6 +164,22 @@ class NodeObjectStore:
                             objects=n_spilled)
             return freed
 
+    def make_room(self, need_bytes: int) -> int:
+        """Spill until ``need_bytes`` could allocate; returns bytes freed.
+        The make-room path behind a worker's direct shm put hitting a full
+        store (the raylet-spills-for-plasma-creates flow,
+        create_request_queue.h:32). Pin handling matches
+        _create_with_spill: residency pins get a short grace before they
+        are broken, so promised direct reads usually land first."""
+        freed = self._spill_for(need_bytes)
+        if freed:
+            return freed
+        time.sleep(min(0.5, self.config.object_store_full_timeout_s / 2))
+        freed = self._spill_for(need_bytes)
+        if freed == 0 and self._release_all_pins():
+            freed = self._spill_for(need_bytes)
+        return freed
+
     def ensure_resident(self, object_id: bytes,
                         grace_s: float = 60.0) -> bool:
         """Make the object shm-resident (restoring from spill if needed) and

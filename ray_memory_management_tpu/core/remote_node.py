@@ -102,6 +102,10 @@ class RemoteStoreProxy:
         blocking waits on the caller's thread)."""
         return self._node.ensure_objects(list(object_ids))
 
+    def make_room(self, nbytes: int) -> bool:
+        """Ask the agent to spill so a worker's direct put can allocate."""
+        return self._node.request_spill(nbytes)
+
     def delete(self, object_id: bytes) -> None:
         self._node.channel_send({"type": "obj_free", "oid": object_id})
 
@@ -290,15 +294,33 @@ class RemoteNodeManager(NodeManager):
             return "fetch timed out"
         return state["error"]
 
+    def request_spill(self, nbytes: int, timeout: float = 60.0) -> bool:
+        """One obj_spill round trip (the make_room path)."""
+        if not self.alive:
+            return False
+        req = self._new_req()
+        with self._pending_lock:
+            state = self._pending.get(req)
+        if state is None or not self.channel_send(
+                {"type": "obj_spill", "bytes": int(nbytes), "req": req}):
+            with self._pending_lock:
+                self._pending.pop(req, None)
+            return False
+        ok = state["event"].wait(timeout)
+        with self._pending_lock:
+            self._pending.pop(req, None)
+        return ok and state["error"] is None
+
     def on_channel_reply(self, msg: dict) -> None:
-        """push_ack / pull_data / ensure_ack / fetch_ack frames routed here
-        by the runtime router."""
+        """push_ack / pull_data / ensure_ack / fetch_ack / spill_ack frames
+        routed here by the runtime router."""
         req = msg.get("req")
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None:
             return
-        if msg["type"] in ("push_ack", "ensure_ack", "fetch_ack"):
+        if msg["type"] in ("push_ack", "ensure_ack", "fetch_ack",
+                           "spill_ack"):
             state["error"] = msg.get("error")
             state["failed"] = msg.get("failed")
             state["event"].set()

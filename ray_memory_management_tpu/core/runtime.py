@@ -108,6 +108,9 @@ class Runtime:
         self.config = config
         self.job_id = JobID.from_random()
         self.namespace = namespace or f"rmt_{os.getpid()}_{id(self) & 0xffff}"
+        from ..native import reap_stale_stores
+
+        reap_stale_stores("rmt_")  # SIGKILLed drivers leave orphans
         from .gcs_storage import open_storage
 
         self.gcs = GCS(open_storage(config.gcs_storage_path))
@@ -427,7 +430,8 @@ class Runtime:
                 self._bind_remote_worker(nm, handle)
                 return
             self._handle_worker_message(handle, inner)
-        elif mtype in ("push_ack", "pull_data", "ensure_ack", "fetch_ack"):
+        elif mtype in ("push_ack", "pull_data", "ensure_ack", "fetch_ack",
+                       "spill_ack"):
             nm.on_channel_reply(msg)
         elif mtype == "transfer_ready":
             # the agent's p2p transfer server is up: record where peers
@@ -2190,6 +2194,11 @@ class Runtime:
             elif mtype == "get_objects":
                 reply["values"] = self._serve_get(
                     handle, msg["oids"], inline=msg.get("inline", False))
+            elif mtype == "make_room":
+                # a worker's direct shm put hit a full store: spill on its
+                # node so the retry can allocate (the raylet-spills-for-
+                # plasma-creates path, create_request_queue.h:32)
+                self._make_room(handle.node_id, int(msg["bytes"]))
             elif mtype == "put_inline":
                 oid = ObjectID.for_put().binary()
                 with self._lock:
@@ -2399,6 +2408,19 @@ class Runtime:
             if data is not None:
                 return data
         return None
+
+    def _make_room(self, node_id: NodeID, nbytes: int) -> None:
+        """Spill a node's store down so ``nbytes`` can allocate (local
+        stores spill directly; remote proxies do one agent round trip)."""
+        nm = self.nodes.get(node_id)
+        if nm is None:
+            return
+        make_room = getattr(nm.store, "make_room", None)
+        if make_room is not None and not make_room(nbytes):
+            events.emit(
+                "STORE_FULL",
+                f"could not spill {nbytes} bytes on {node_id.hex()[:8]}",
+                severity=events.WARNING, source="object_store")
 
     def _inline_bytes_from_store(self, nm, oid: bytes) -> Optional[bytes]:
         """Envelope bytes from a node's store without forcing shm residency

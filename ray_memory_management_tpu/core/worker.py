@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import os
+import sys
 import threading
 import time
 import traceback
@@ -462,15 +463,52 @@ class Worker:
 
     def encode_returns(self, values: List[Any], return_ids: List[bytes]):
         """Small returns inline in the reply (owner memory store); big ones go
-        straight to shm (core_worker.cc:892 PutInLocalPlasmaStore analog)."""
+        straight to shm (core_worker.cc:892 PutInLocalPlasmaStore analog).
+
+        A full store is the owner's problem, not a task failure: the worker
+        asks the owner to make room (the owner spills the node's store — a
+        plasma create triggering raylet spilling, create_request_queue.h:32)
+        and retries; if the store STILL cannot take it, the value ships
+        inline in the reply as the last resort."""
+        from ..native import ShmStoreFullError
+
         encoded = []
         for value, oid in zip(values, return_ids):
             data = ser.serialize(value)
             if data.total_size <= self.inline_limit:
                 encoded.append((oid, "v", data.to_bytes()))
-            else:
-                self.store.put_serialized(oid, data)
+                continue
+            stored = False
+            for attempt in range(2):
+                try:
+                    self.store.put_serialized(oid, data)
+                    stored = True
+                    break
+                except ShmStoreFullError:
+                    if attempt == 0:
+                        try:
+                            self.proxy._request(
+                                {"type": "make_room",
+                                 "bytes": data.total_size}, timeout=60)
+                        except Exception:  # noqa: BLE001 — fall through
+                            break
+            if stored:
                 encoded.append((oid, "store", data.total_size))
+            else:
+                # visible degradation: the value bypasses the object store
+                # and lands in owner memory — if this repeats, the store is
+                # undersized for the workload
+                from ..utils import events
+
+                events.emit(
+                    "RETURN_INLINED",
+                    f"store full even after spilling; shipping a "
+                    f"{data.total_size}-byte return inline",
+                    severity=events.WARNING, source="core_worker")
+                print(f"[rmt] WARNING: node store full; return of "
+                      f"{data.total_size} bytes shipped inline",
+                      file=sys.stderr, flush=True)
+                encoded.append((oid, "v", data.to_bytes()))
         return encoded
 
     # -- execution ------------------------------------------------------------

@@ -1,1 +1,5 @@
-from .shm_client import ShmStore, ShmStoreFullError  # noqa: F401
+from .shm_client import (  # noqa: F401
+    ShmStore,
+    ShmStoreFullError,
+    reap_stale_stores,
+)

@@ -62,6 +62,33 @@ class ShmStoreFullError(Exception):
     pass
 
 
+def reap_stale_stores(prefix: str) -> None:
+    """Unlink /dev/shm segments named ``<prefix><pid>_...`` whose owning
+    pid is gone — a SIGKILLed owner cannot unlink its own stores, and
+    without this a crash-looping process fills /dev/shm. Called at head
+    init (prefix "rmt_") and agent start (prefix "rmtA_")."""
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        try:
+            pid = int(name[len(prefix):].split("_")[0])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(f"/dev/shm/{name}")
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # pid alive under another uid
+
+
 class ShmStore:
     """One named store; open with ``create=True`` exactly once per store."""
 

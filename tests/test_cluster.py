@@ -235,3 +235,41 @@ def test_custom_resources():
         assert rmt.cluster_resources().get("widget") == 2.0
     finally:
         rmt.shutdown()
+
+
+def test_worker_return_spills_full_store():
+    """A task return larger than the node store's free space must trigger
+    owner-side spilling (the raylet-spills-for-plasma-creates path) — not
+    a task failure — on both local and remote-agent nodes."""
+    from ray_memory_management_tpu.config import Config
+    from ray_memory_management_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cfg = Config(object_store_memory=48 << 20)
+    rt = rmt.init(num_cpus=2, _config=cfg)
+    try:
+        remote_id = rt.add_remote_node_process(num_cpus=2)
+
+        @rmt.remote(max_retries=0)
+        def produce(mb):
+            return np.ones(mb << 18, np.float32)  # mb MB
+
+        for target in (rt.head_node().node_id, remote_id):
+            refs = [produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=target, soft=False)).remote(20)
+                for _ in range(3)]  # 60 MB of returns into a 48 MB store
+            rmt.wait(refs, num_returns=3, timeout=180)
+            if target == rt.head_node().node_id:
+                # load-bearing: checked BEFORE the reads restore spilled
+                # objects (restores pop the spill records) — the values
+                # must have gone through the STORE via the make_room
+                # spill path, not the inline last-resort fallback
+                assert rt.head_node().store.spilled_count() > 0, \
+                    "head store never spilled: returns bypassed the store"
+            for r in refs:
+                assert float(rmt.get(r, timeout=180)[0]) == 1.0
+            del refs
+    finally:
+        rmt.shutdown()
