@@ -20,11 +20,16 @@ BASELINE = {
     "single_client_tasks_async": 13150.0,
     "1_1_actor_calls_sync": 2490.0,
     "1_1_actor_calls_async": 6146.0,
+    "1_1_actor_calls_concurrent": 4825.0,
+    "1_1_async_actor_calls_async": 3322.0,
     "1_n_actor_calls_async": 11532.0,
     "single_client_put_calls": 5390.0,
     "single_client_get_calls": 5403.0,
     "single_client_put_gigabytes": 19.67,
+    "single_client_get_object_containing_10k_refs": 13.3,
     "placement_group_create/removal": 1243.0,
+    "client__put_gigabytes": 0.044,
+    "client__1_1_actor_calls_sync": 536.0,
 }
 
 
@@ -93,6 +98,26 @@ def run_microbenchmark(scale: float = 1.0,
 
         results["1_1_actor_calls_async"] = _timeit(actor_async, int(3000 * scale))
 
+    if want("1_1_actor_calls_concurrent"):
+        conc = Sink.options(max_concurrency=4).remote()
+        rmt.get(conc.ping.remote(), timeout=120)
+
+        def actor_concurrent(n):
+            rmt.get([conc.ping.remote() for _ in range(n)], timeout=300)
+
+        results["1_1_actor_calls_concurrent"] = _timeit(
+            actor_concurrent, int(3000 * scale))
+
+    if want("1_1_async_actor_calls_async"):
+        aactor = Sink.remote()
+        rmt.get(aactor.aping.remote(), timeout=120)
+
+        def async_actor(n):
+            rmt.get([aactor.aping.remote() for _ in range(n)], timeout=300)
+
+        results["1_1_async_actor_calls_async"] = _timeit(
+            async_actor, int(2000 * scale))
+
     if want("1_n_actor_calls_async"):
         n_actors = 4
         actors = [Sink.remote() for _ in range(n_actors)]
@@ -140,6 +165,19 @@ def run_microbenchmark(scale: float = 1.0,
         chunks_per_s = _timeit(put_gb, n_chunks)
         results["single_client_put_gigabytes"] = chunks_per_s * 16 / 1024
 
+    if want("single_client_get_object_containing_10k_refs"):
+        inner = [rmt.put(i) for i in range(10_000)]
+        wrapper = rmt.put(inner)
+
+        def get_refs(n):
+            for _ in range(n):
+                got = rmt.get(wrapper)
+                assert len(got) == 10_000
+
+        results["single_client_get_object_containing_10k_refs"] = _timeit(
+            get_refs, max(3, int(10 * scale)))
+        del inner, wrapper
+
     if want("placement_group_create/removal"):
         from ..core.placement_group import (
             placement_group, remove_placement_group,
@@ -152,6 +190,44 @@ def run_microbenchmark(scale: float = 1.0,
                 remove_placement_group(pg)
 
         results["placement_group_create/removal"] = _timeit(pgs, int(300 * scale))
+
+    if want("client__put_gigabytes") or want("client__1_1_actor_calls_sync"):
+        # thin-client rows: a ClientBackend drives the cluster over the
+        # authenticated TCP channel (the reference's ray-client gRPC proxy)
+        from .. import _worker_context
+        from ..client import ClientBackend
+        from ..client.server import ClusterServer
+
+        server = ClusterServer(port=0)
+        cb = ClientBackend(server.address[0], server.address[1])
+        try:
+            if want("client__put_gigabytes"):
+                blob = np.ones(4 * 1024 * 1024 // 4, np.float32)  # 4 MB
+
+                def client_puts(n):
+                    for _ in range(n):
+                        cb.put_object(blob)
+
+                per_s = _timeit(client_puts, max(4, int(32 * scale)))
+                results["client__put_gigabytes"] = per_s * 4 / 1024
+
+            if want("client__1_1_actor_calls_sync"):
+                actor = Sink.remote()
+                rmt.get(actor.ping.remote(), timeout=120)
+                actor_id = actor._actor_id
+
+                def client_actor_sync(n):
+                    for _ in range(n):
+                        oids = cb.submit_actor_task({
+                            "actor_id": actor_id, "method": "ping",
+                            "args": [], "kwargs": {}, "num_returns": 1})
+                        cb.get_objects(oids, timeout=60)
+
+                results["client__1_1_actor_calls_sync"] = _timeit(
+                    client_actor_sync, int(300 * scale))
+        finally:
+            cb.close()
+            server.close()
 
     return results
 
