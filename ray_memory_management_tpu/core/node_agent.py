@@ -140,14 +140,22 @@ class NodeAgent:
         # frames that drain capacity. FIFO per-frame ordering within the
         # plane (push -> chunk -> seal) is preserved by the single queue.
         self._obj_q: deque = deque()
-        self._obj_q_bytes = 0  # payload bytes queued (chunk frames)
+        self._obj_q_bytes = 0  # payload bytes admitted (accounted at push)
         # cap on queued payload so a blocked store never buffers an entire
-        # multi-GB transfer backlog in agent RAM: past it the recv loop
-        # parks, which stops draining the socket and pushes the pressure
-        # back to TCP (the reference's PullManager caps in-flight bytes the
-        # same way, pull_manager.h:47)
+        # multi-GB transfer backlog in agent RAM. The recv loop must NEVER
+        # park on this: while parked it stops reading ping and obj_free —
+        # obj_free is exactly what frees store capacity so the plane can
+        # drain, and a filled TCP buffer blocks head-side channel_send,
+        # stalling the head's serial heartbeat loop for EVERY node. Instead
+        # a push whose declared size would exceed the budget is nacked
+        # (push_ack error) and its chunks discarded as they arrive; the
+        # head-side push_object returns False and the caller retries or
+        # routes elsewhere (the reference's PullManager bounds in-flight
+        # bytes by admission the same way, pull_manager.h:47).
         self._obj_q_limit = max(64 << 20,
                                 4 * self.config.object_manager_chunk_size)
+        self._push_acct: Dict[bytes, int] = {}  # oid -> unaccounted bytes
+        self._dropped_pushes: Dict[bytes, bool] = {}  # oid -> nack pending
         self._obj_cond = threading.Condition()
         # frees that arrived while a push of the same object was still
         # queued/mid-flight: consumed by _obj_push/_obj_seal so the freed
@@ -282,30 +290,33 @@ class NodeAgent:
         # the mark-or-seal decision is atomic against the recv thread's
         # contains-or-mark in obj_free: without the mutex a free landing
         # between our marker check and store.seal() would resurrect the
-        # freed object with no future delete ever coming
+        # freed object with no future delete ever coming. The freed path
+        # also runs UNDER the mutex and deletes the unsealed create
+        # directly (delete() aborts unsealed entries, shmstore.cpp:379):
+        # seal-then-delete would briefly publish the freed object as live,
+        # and a concurrent reader ref in that window — or a failed delete —
+        # would resurrect it with no future delete ever coming.
         with self._free_mu:
             freed = self._freed_while_pushing.pop(oid, None) is not None
-            if not freed and oid in self._push_bufs:
+            if freed:
+                buf = self._push_bufs.pop(oid, None)
+                if buf is not None:
+                    del buf
+                    try:
+                        self.store.delete(oid)
+                    except Exception:
+                        pass
+                err = "object freed during push"
+            elif oid in self._push_bufs:
                 del self._push_bufs[oid]
                 try:
                     self.store.seal(oid)
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
-            elif not freed and not self.store.contains(oid):
+            elif not self.store.contains(oid):
                 # this push's create was refused and nobody else sealed it:
                 # acking success would poison the head's object directory
                 err = "push raced an incomplete object"
-        if freed:
-            # drop the landed bytes instead of resurrecting a freed object
-            buf = self._push_bufs.pop(oid, None)
-            if buf is not None:
-                del buf
-                try:
-                    self.store.seal(oid)  # must seal before delete
-                    self.store.delete(oid)
-                except Exception:
-                    pass
-            err = "object freed during push"
         self._send({"type": "push_ack", "req": msg["req"], "error": err})
 
     def _obj_pull(self, msg: dict) -> None:
@@ -409,8 +420,15 @@ class NodeAgent:
                         return
                 msg = self._obj_q.popleft()
                 if msg["type"] == "obj_chunk":
-                    self._obj_q_bytes -= len(msg["data"])
-                    self._obj_cond.notify_all()  # recv loop may be parked
+                    rem = self._push_acct.get(msg["oid"])
+                    if rem is not None:
+                        dec = min(len(msg["data"]), rem)
+                        self._push_acct[msg["oid"]] = rem - dec
+                        self._obj_q_bytes -= dec
+                elif msg["type"] == "obj_seal":
+                    # release whatever the chunks didn't cover (a push that
+                    # errored mid-stream must not leak admitted bytes)
+                    self._obj_q_bytes -= self._push_acct.pop(msg["oid"], 0)
             try:
                 handlers[msg["type"]](msg)
             except Exception:  # noqa: BLE001 — one bad frame must not
@@ -452,17 +470,56 @@ class NodeAgent:
                         pass
             elif t == "obj_fetch":
                 self._obj_fetch(msg)  # non-blocking: pool submit
-            elif t in ("obj_push", "obj_chunk", "obj_seal", "obj_pull",
-                       "obj_ensure", "obj_spill"):
-                nbytes = len(msg["data"]) if t == "obj_chunk" else 0
+            elif t == "obj_push":
+                # admission control, never parking: admit the push if its
+                # declared size fits the payload budget, else nack it and
+                # discard its chunks as they stream past (the recv loop
+                # must keep reading ping/obj_free — see _obj_q_limit)
+                oid = msg["oid"]
                 with self._obj_cond:
-                    # backpressure: park (stop reading the socket) rather
-                    # than buffer an unbounded backlog in agent memory
-                    while (self._obj_q_bytes > self._obj_q_limit
-                           and not self._stop.is_set()):
-                        self._obj_cond.wait(timeout=1.0)
+                    dup = oid in self._push_acct
+                    # an idle plane always admits, whatever the size —
+                    # otherwise a single object larger than the budget
+                    # could never transfer at all; with bytes already
+                    # queued the backlog is bounded at limit + one object
+                    over = (not dup and self._obj_q_bytes > 0
+                            and self._obj_q_bytes + msg["size"]
+                            > self._obj_q_limit)
+                    if not over:
+                        # a stale dropped-marker from an earlier nacked
+                        # attempt must not swallow this admitted push's
+                        # chunks (and leak its admitted bytes forever)
+                        self._dropped_pushes.pop(oid, None)
+                        if not dup:
+                            self._push_acct[oid] = msg["size"]
+                            self._obj_q_bytes += msg["size"]
+                        self._obj_q.append(msg)
+                        self._obj_cond.notify()
+                if over:
+                    while len(self._dropped_pushes) > 4096:
+                        self._dropped_pushes.pop(
+                            next(iter(self._dropped_pushes)))
+                    self._dropped_pushes[oid] = True
+                    # nack NOW (the push frame carries req): the head's
+                    # chunk loop aborts on the early ack instead of
+                    # streaming the whole payload just to be discarded
+                    try:
+                        self._send({
+                            "type": "push_ack", "req": msg["req"],
+                            "error": "push dropped: object plane over "
+                                     "budget (retryable)"})
+                    except (OSError, BrokenPipeError):
+                        pass
+            elif t == "obj_chunk" and msg["oid"] in self._dropped_pushes:
+                pass  # chunk of a nacked push: discard without queueing
+            elif t == "obj_seal" and msg["oid"] in self._dropped_pushes:
+                # the nack already went out with the obj_push's req; the
+                # seal of a dropped push just clears the marker
+                self._dropped_pushes.pop(msg["oid"], None)
+            elif t in ("obj_chunk", "obj_seal", "obj_pull",
+                       "obj_ensure", "obj_spill"):
+                with self._obj_cond:
                     self._obj_q.append(msg)
-                    self._obj_q_bytes += nbytes
                     self._obj_cond.notify()
             elif t == "obj_free":
                 oid = msg["oid"]

@@ -211,25 +211,40 @@ class NodeObjectStore:
 
     # -- read path ------------------------------------------------------------
     def get(self, object_id: bytes) -> Optional[memoryview]:
-        """Zero-copy view, restoring from spill if needed. None if absent."""
-        for _ in range(4):
+        """Zero-copy view, restoring from spill if needed. None if absent.
+
+        The retry loop is deadline-based, not attempt-counted: under
+        restore/spill thrash a reader can lose the wait on concurrent
+        restores many times while the object is genuinely present
+        (resident or spilled), and giving up early surfaces upstream as a
+        spurious ObjectLostError."""
+        timeout_s = self.config.object_store_full_timeout_s
+        # waiting out another thread's in-flight restore is PRODUCTIVE and
+        # gets the full per-restore budget each time it happens; the hard
+        # deadline only backstops a wedged restorer so get() cannot spin
+        # forever. Every non-wait branch below returns an authoritative
+        # answer, so the loop only iterates through restore waits.
+        hard_deadline = time.monotonic() + 4 * (timeout_s + 5.0)
+        while True:
             view = self.shm.get(object_id)
             if view is not None:
                 return view
+            if time.monotonic() >= hard_deadline:
+                return self.shm.get(object_id)
             with self._restore_mu:
                 ev = self._restoring.get(object_id)
             if ev is not None:
                 # another thread is restoring this object: wait it out,
                 # then re-check shm (loop)
-                ev.wait(self.config.object_store_full_timeout_s + 5.0)
+                ev.wait(timeout_s + 5.0)
                 continue
             with self._spill_lock:
                 spilled = object_id in self._spilled
             if not spilled:
                 # a restore may have completed between our shm miss and the
                 # spill-record check (moving the object file -> shm): the
-                # re-check is what makes a hit authoritative; a second miss
-                # with no spill copy and no in-flight restore means absent
+                # re-check is what makes a hit authoritative; a miss with
+                # no spill copy and no in-flight restore means absent
                 return self.shm.get(object_id)
             with self._restore_mu:
                 ev = self._restoring.get(object_id)
@@ -237,7 +252,7 @@ class NodeObjectStore:
                 if owner:
                     ev = self._restoring[object_id] = threading.Event()
             if not owner:
-                ev.wait(self.config.object_store_full_timeout_s + 5.0)
+                ev.wait(timeout_s + 5.0)
                 continue
             try:
                 return self._restore_into_shm(object_id)
@@ -245,7 +260,6 @@ class NodeObjectStore:
                 with self._restore_mu:
                     self._restoring.pop(object_id, None)
                 ev.set()
-        return None
 
     def _restore_into_shm(self, object_id: bytes) -> Optional[memoryview]:
         """Move one spilled object back into shm; returns a referenced view

@@ -112,7 +112,14 @@ class RemoteStoreProxy:
     def put_serialized(self, object_id: bytes, serialized) -> None:
         buf = bytearray(serialized.total_size)
         serialized.write_into(memoryview(buf))
-        self._node.push_object(object_id, memoryview(buf))
+        if not self._node.push_object(object_id, memoryview(buf)):
+            # raising keeps callers from registering a GCS location for an
+            # object the agent never landed
+            from ..exceptions import ObjectStoreFullError
+
+            raise ObjectStoreFullError(
+                f"push of {object_id.hex()[:8]} to "
+                f"{self._node.hostname} failed")
 
     def usage(self):
         return (0, 0)
@@ -205,24 +212,45 @@ class RemoteNodeManager(NodeManager):
 
     def push_object(self, object_id: bytes, view: memoryview,
                     timeout: float = 120.0) -> bool:
-        """Chunked push (ObjectManager::Push analog)."""
+        """Chunked push (ObjectManager::Push analog). A push the agent
+        nacks under payload-budget backpressure (its admission control
+        nacks rather than parking its recv loop) is retried here with
+        backoff — congestion is transient by construction: the plane
+        drains as the store frees."""
+        backoff = 0.2
+        while True:
+            ok, err = self._push_object_once(object_id, view, timeout)
+            if ok or not self.alive:
+                return ok
+            if not (err and "retryable" in err) or backoff > 4.0:
+                return False
+            time.sleep(backoff)
+            backoff *= 2
+
+    def _push_object_once(self, object_id: bytes, view: memoryview,
+                          timeout: float):
+        """One push attempt; returns (ok, error_string)."""
         if not self.alive:
-            return False
+            return False, "node dead"
         with self._push_lock:
             # a concurrent transfer may have landed this object already
             if self.gcs is not None and self.node_id in \
                     self.gcs.get_object_locations(object_id):
-                return True
+                return True, None
             req = self._new_req()
             with self._pending_lock:
                 state = self._pending.get(req)
             if state is None:
-                return False
+                return False, "shutting down"
             chunk = self.config.object_manager_chunk_size
+            # req rides the obj_push frame so the agent can nack an
+            # over-budget push IMMEDIATELY; the early ack sets our event
+            # and the chunk loop aborts instead of streaming the whole
+            # payload through the channel just to be discarded
             ok = self.channel_send({"type": "obj_push", "oid": object_id,
-                                    "size": view.nbytes})
+                                    "size": view.nbytes, "req": req})
             for off in range(0, view.nbytes, chunk):
-                if not ok:
+                if not ok or state["event"].is_set():
                     break
                 end = min(off + chunk, view.nbytes)
                 ok = self.channel_send({
@@ -234,14 +262,14 @@ class RemoteNodeManager(NodeManager):
             if not ok:
                 with self._pending_lock:
                     self._pending.pop(req, None)
-                return False
+                return False, "channel send failed"
             if not state["event"].wait(timeout):
                 with self._pending_lock:
                     self._pending.pop(req, None)
-                return False
+                return False, "timeout"
             with self._pending_lock:
                 self._pending.pop(req, None)
-            return state["error"] is None
+            return state["error"] is None, state["error"]
 
     def ensure_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
         """Ask the agent to make the object shm-resident (restoring from its

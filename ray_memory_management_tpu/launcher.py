@@ -168,13 +168,15 @@ class GCETPUProvider(NodeProvider):
     model the multi-host plane expects."""
 
     def __init__(self, provider_cfg: Dict[str, Any], log_dir: str = ""):
+        import itertools
+
         self.gcloud = provider_cfg.get("gcloud_command", "gcloud")
         self.project = provider_cfg.get("project", "")
         self.zone = provider_cfg.get("zone", "")
         self.python = provider_cfg.get("remote_python", "python3")
         self.bootstrap = provider_cfg.get("bootstrap", "")
         self.log_dir = log_dir
-        self._count = 0
+        self._counter = itertools.count(1)  # thread-safe (CPython atomic)
 
     def _scope(self) -> List[str]:
         out = []
@@ -187,8 +189,7 @@ class GCETPUProvider(NodeProvider):
     def launch_worker(self, spec, head_addr, authkey_hex):
         import threading
 
-        self._count += 1
-        name = spec.get("name", f"rmt-worker-{self._count}")
+        name = spec.get("name", f"rmt-worker-{next(self._counter)}")
         create = [
             self.gcloud, "compute", "tpus", "tpu-vm", "create", name,
             *self._scope(),
@@ -208,8 +209,14 @@ class GCETPUProvider(NodeProvider):
             self.gcloud, "compute", "tpus", "tpu-vm", "ssh", name,
             *self._scope(), "--worker=all", "--command", agent_cmd,
         ]
+        # _mu makes terminate-vs-provision atomic: the delete can run while
+        # the up-to-30-minute create is still in flight, and without the
+        # cancelled check the late-finishing provision would spawn the ssh
+        # agent anyway (pid was None at kill time), leaving an orphan agent
+        # dialing the head against a deleted VM
         record = {"kind": "gce-tpu", "pid": None, "name": name,
-                  "error": None}
+                  "error": None, "cancelled": False,
+                  "_mu": threading.Lock()}
 
         def provision():
             # create takes MINUTES per TPU VM: run it off the caller so a
@@ -220,17 +227,48 @@ class GCETPUProvider(NodeProvider):
             if rc.returncode != 0:
                 record["error"] = rc.stderr.strip()[-500:]
                 return
-            proc = subprocess.Popen(
-                ssh, close_fds=True,
-                **_daemon_log(self.log_dir, f"gce-{name}"))
-            record["pid"] = proc.pid
+            with record["_mu"]:
+                cancelled = record["cancelled"]
+                if not cancelled:
+                    proc = subprocess.Popen(
+                        ssh, close_fds=True,
+                        **_daemon_log(self.log_dir, f"gce-{name}"))
+                    record["pid"] = proc.pid
+            if cancelled:
+                # terminate_worker already ran — its delete hit a VM that
+                # didn't exist yet, so the create we just finished made a
+                # fresh (billed) VM nobody else will clean up: delete it
+                # here, outside the lock, and RECORD any failure (a billed
+                # VM silently leaking is the worst outcome)
+                try:
+                    rc = subprocess.run(
+                        [self.gcloud, "compute", "tpus", "tpu-vm",
+                         "delete", name, *self._scope(), "--quiet"],
+                        capture_output=True, text=True, timeout=1800)
+                    # terminate_worker may have won the race and deleted
+                    # the VM itself — a not-found delete is a success, not
+                    # a leak
+                    if rc.returncode != 0 and "not found" not in \
+                            rc.stderr.lower():
+                        record["error"] = ("cleanup delete failed — VM "
+                                           f"{name} may be leaked: "
+                                           + rc.stderr.strip()[-400:])
+                except Exception as e:  # noqa: BLE001
+                    record["error"] = ("cleanup delete failed — VM "
+                                       f"{name} may be leaked: {e!r}")
 
         threading.Thread(target=provision, daemon=True,
                          name=f"gce-up-{name}").start()
         return record
 
     def terminate_worker(self, record):
-        pid = record.get("pid")
+        mu = record.get("_mu")
+        if mu is not None:
+            with mu:
+                record["cancelled"] = True
+                pid = record.get("pid")
+        else:
+            pid = record.get("pid")
         if pid:
             try:
                 os.kill(pid, signal.SIGTERM)  # drop the ssh channel
