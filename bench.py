@@ -24,43 +24,61 @@ import json
 import sys
 
 
-def _tpu_available() -> bool:
-    """Probe the TPU in a SUBPROCESS with a hard timeout: a dead tunnel
-    hangs jax backend init outright (no exception to catch), and that
-    must cost this run 120s, not the whole bench. The probe pays one
-    extra backend init on healthy hosts — set RMT_BENCH_ASSUME_TPU=1 to
-    skip it when the TPU is known-good."""
+def _tpu_available():
+    """Probe the TPU in a SUBPROCESS with a hard timeout and RETRIES: a
+    dead tunnel hangs jax backend init outright (no exception to catch),
+    and tunnels flap — one failed probe must not silently cost the round
+    its entire TPU section. Returns (ok, error_string): the error goes
+    INTO the bench JSON so a skipped TPU suite is loud, not a silent
+    omission. Set RMT_BENCH_ASSUME_TPU=1 to skip the probe when the TPU
+    is known-good."""
     import os
     import subprocess
+    import time
 
     if os.environ.get("RMT_BENCH_ASSUME_TPU"):
-        return True
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=120)
-    except subprocess.TimeoutExpired:
-        print("  tpu probe timed out (tunnel down?)", file=sys.stderr)
-        return False
-    return probe.returncode == 0 and "tpu" in probe.stdout
+        return True, None
+    delays = [0, 30, 60]  # three attempts with backoff between them
+    last = "unknown"
+    for i, delay in enumerate(delays):
+        if delay:
+            print(f"  tpu probe retrying in {delay}s "
+                  f"(attempt {i + 1}/{len(delays)})", file=sys.stderr)
+            time.sleep(delay)
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=180)
+        except subprocess.TimeoutExpired:
+            last = "probe timed out after 180s (tunnel down?)"
+            print(f"  tpu {last}", file=sys.stderr)
+            continue
+        if probe.returncode == 0 and "tpu" in probe.stdout:
+            return True, None
+        last = (f"probe rc={probe.returncode} "
+                f"stdout={probe.stdout.strip()[:120]!r} "
+                f"stderr={probe.stderr.strip()[-200:]!r}")
+        print(f"  tpu {last}", file=sys.stderr)
+    return False, last
 
 
 def _tpu_suite():
     """TPU compute benchmarks; returns a dict for the JSON line (or None
     off-TPU). Each sub-benchmark is independently fault-isolated so a
     regression in one still reports the others."""
-    if not _tpu_available():
+    ok, err = _tpu_available()
+    if not ok:
         print("  tpu suite skipped: no reachable TPU", file=sys.stderr)
-        return None
+        return {"error": f"no reachable TPU: {err}"}
     try:
         from ray_memory_management_tpu.utils import tpu_bench
 
         if not tpu_bench.on_tpu():
-            return None
+            return {"error": "jax default backend is not TPU"}
     except Exception as e:
         print(f"  tpu suite unavailable: {e!r}", file=sys.stderr)
-        return None
+        return {"error": f"tpu suite unavailable: {e!r}"}
     out = {}
     train_rows = [
         # (tag, kwargs): the flagship row plus the long-context and the
@@ -130,15 +148,21 @@ def _scale_suite():
             SCALE_BASELINE, run_scale_suite, vs_scale_baseline,
         )
 
-        results = run_scale_suite()
+        results, stats = run_scale_suite()
         ratios = vs_scale_baseline(results)
         for k in sorted(results):
             base = SCALE_BASELINE.get(k)
             extra = f", {ratios[k]:5.2f}x" if k in ratios else ""
-            print(f"  scale {k:28s} {results[k]:12.1f} "
+            s = stats.get(k, {})
+            spread = (f" [{s['min']:.2f}..{s['max']:.2f}]"
+                      if "min" in s else "")
+            print(f"  scale {k:28s} {results[k]:12.2f}{spread} "
                   f"(baseline {base if base is not None else '—'}{extra})",
                   file=sys.stderr)
-        return {k: round(v, 2) for k, v in results.items()}
+        out = {k: round(v, 2) for k, v in results.items()}
+        out["stats"] = {k: {kk: round(vv, 3) for kk, vv in s.items()}
+                        for k, s in stats.items()}
+        return out
     except Exception as e:  # pragma: no cover - keep the headline alive
         print(f"  scale suite failed: {e!r}", file=sys.stderr)
         return None
@@ -151,12 +175,16 @@ def main() -> None:
     )
 
     rmt.init(num_cpus=8)
+    stats = {}
     try:
-        results = run_microbenchmark(scale=1.0)
+        results = run_microbenchmark(scale=1.0, collect_stats=stats)
         ratios = vs_baseline(results)
         for k in sorted(results):
+            s = stats.get(k, {})
+            spread = (f" [{s['min']:.1f}..{s['max']:.1f}]"
+                      if "min" in s else "")
             print(
-                f"  {k:42s} {results[k]:12.1f} "
+                f"  {k:42s} {results[k]:12.1f}{spread} "
                 f"(baseline {BASELINE.get(k, float('nan')):10.1f}, "
                 f"{ratios.get(k, 0):5.2f}x)",
                 file=sys.stderr,
@@ -175,6 +203,8 @@ def main() -> None:
         "unit": "x_baseline",
         "vs_baseline": round(gm, 4),
     }
+    if stats:
+        line["micro_stats"] = stats
     if scale:
         line["scale"] = scale
     if tpu:

@@ -65,6 +65,11 @@ _flag("max_tasks_in_flight_per_worker", int, 10,
       "worker's held lease queue on its pipe instead of waiting for the "
       "owner round trip (the reference's small-task pipelining knob, "
       "max_tasks_in_flight_per_worker in the direct task transport).")
+_flag("worker_fork_server", bool, True,
+      "Fork CPU-platform workers from a pre-warmed zygote process (ms "
+      "spawns) instead of exec'ing a fresh interpreter (the reference's "
+      "WorkerPool prestart/reuse economics, worker_pool.h:104,349,427). "
+      "TPU-platform workers always cold-spawn.")
 _flag("cpu_worker_env_drop", str, "PALLAS_AXON_POOL_IPS",
       "Comma-separated env vars dropped when spawning CPU-platform workers "
       "— accelerator-bootstrap triggers (sitecustomize TPU plugin init) "

@@ -125,12 +125,14 @@ class NodeAgent:
             "port": self.transfer_server.port,
         })
 
-        self._authkey = os.urandom(16)
+        # permission-trusted worker socket, like the head's (0600 file;
+        # no HMAC challenge — two round trips saved per worker connect)
         self._socket_path = f"/tmp/rmtA_{os.getpid()}_{os.urandom(4).hex()}.sock"
-        self._listener = Listener(self._socket_path, family="AF_UNIX",
-                                  authkey=self._authkey)
+        self._listener = Listener(self._socket_path, family="AF_UNIX")
+        os.chmod(self._socket_path, 0o600)
         self._workers: Dict[bytes, Any] = {}        # wid -> conn
         self._worker_procs: Dict[bytes, Any] = {}   # wid -> Popen
+        self._pending_bootstrap: Dict[bytes, dict] = {}  # cold-spawn tokens
         self._worker_send_locks: Dict[bytes, threading.Lock] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -165,6 +167,13 @@ class NodeAgent:
         # dict (insertion-ordered) so overflow evicts the STALEST marker
         self._freed_while_pushing: Dict[bytes, bool] = {}
         self._free_mu = threading.Lock()
+        # warm the fork server while the node is idle: the first actor
+        # burst should never pay the zygote's preload
+        if self.config.worker_fork_server:
+            from . import zygote as _zygote
+
+            threading.Thread(target=_zygote.get_global, daemon=True,
+                             name="agent-zygote-warm").start()
         threading.Thread(target=self._obj_plane_loop, daemon=True,
                          name="agent-objplane").start()
         threading.Thread(target=self._accept_loop, daemon=True,
@@ -193,6 +202,13 @@ class NodeAgent:
             except (EOFError, OSError):
                 conn.close()
                 continue
+            # a bootstrapped worker can reply so fast that its sender
+            # coalesces ready + actor_ready into one batch frame: forward
+            # the trailing replies as separate wmsg frames after the ready
+            trailing = []
+            if msg.get("type") == "batch" and msg["msgs"]:
+                trailing = msg["msgs"][1:]
+                msg = msg["msgs"][0]
             if msg.get("type") != "ready":
                 conn.close()
                 continue
@@ -200,7 +216,17 @@ class NodeAgent:
             with self._lock:
                 self._workers[wid] = conn
                 self._worker_send_locks[wid] = threading.Lock()
+                boot = self._pending_bootstrap.pop(wid, None)
+            if boot is not None:
+                # cold-spawned worker with a held startup token: deliver
+                # it now, before the head even learns the worker is up
+                try:
+                    conn.send(boot)
+                except (OSError, BrokenPipeError):
+                    pass  # reader thread will report wdeath
             self._send({"type": "wmsg", "wid": wid, "msg": msg})
+            for m in trailing:
+                self._send({"type": "wmsg", "wid": wid, "msg": m})
             threading.Thread(target=self._worker_reader, args=(wid, conn),
                              daemon=True, name="agent-wreader").start()
 
@@ -223,20 +249,27 @@ class NodeAgent:
             pass
 
     def _start_worker(self, msg: dict) -> None:
-        from .node_manager import build_worker_env
+        from .node_manager import build_worker_env, spawn_worker_process
 
         wid_hex = msg["wid_hex"]
+        wid = bytes.fromhex(wid_hex)
         env = build_worker_env(wid_hex, self.node_id.hex(), self.store_name,
-                               self._socket_path, self._authkey.hex(),
+                               self._socket_path, "",
                                self.config)
         env.update(msg.get("env") or {})
-        proc = subprocess.Popen(
-            [sys.executable, "-m",
-             "ray_memory_management_tpu.core.worker_main"],
-            env=env, close_fds=True,
-        )
+        bootstrap = msg.get("bootstrap")
+
+        def queue_bootstrap():
+            # cold spawn: hold the token and deliver it when the worker
+            # dials in (the _accept_loop checks this map). Runs before
+            # the process exists, so the dial-in cannot have happened.
+            with self._lock:
+                self._pending_bootstrap[wid] = bootstrap
+
+        proc = spawn_worker_process(env, self.config, bootstrap,
+                                    queue_bootstrap)
         with self._lock:
-            self._worker_procs[bytes.fromhex(wid_hex)] = proc
+            self._worker_procs[wid] = proc
 
     def _reap_loop(self) -> None:
         """Detect workers that die WITHOUT ever dialing in (import error,
@@ -255,6 +288,10 @@ class NodeAgent:
                         if p.poll() is not None]
                 for wid, _ in dead:
                     self._worker_procs.pop(wid, None)
+                    # a worker that died before dialing in never collected
+                    # its startup token; drop it or it leaks (cls blobs
+                    # are multi-KB and actor churn is unbounded)
+                    self._pending_bootstrap.pop(wid, None)
                 connected = set(self._workers)
             for wid, _ in dead:
                 if wid not in connected:
@@ -565,6 +602,9 @@ class NodeAgent:
                 proc.terminate()
             except Exception:
                 pass
+        from . import zygote as _zygote
+
+        _zygote.shutdown_global()
         try:
             self._listener.close()
         except Exception:

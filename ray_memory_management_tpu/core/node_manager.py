@@ -76,6 +76,39 @@ class WorkerHandle:
         return self.proc.poll() is None
 
 
+class _PendingProc:
+    """Placeholder process for a WorkerHandle registered before its OS
+    process exists (start_worker registers first so a fast bootstrapped
+    fork can never answer before the bookkeeping is visible)."""
+
+    returncode = None
+
+    def poll(self):
+        return None
+
+    def terminate(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+    def wait(self, timeout=None) -> int:
+        return 0
+
+
+def package_env() -> Dict[str, str]:
+    """A copy of this process's environment with PYTHONPATH arranged so
+    spawned processes can import this package from any cwd (the checkout is
+    the install; there is no pip-installed copy to fall back on)."""
+    env = dict(os.environ)
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if pkg_parent not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + parts)
+    return env
+
+
 def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
                      socket_path: str, authkey_hex: str,
                      config: Config) -> Dict[str, str]:
@@ -86,14 +119,7 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
     driver's JAX_PLATFORMS is deliberately NOT inherited). Set
     RMT_WORKER_JAX_PLATFORMS=tpu on the driver to spawn TPU-capable
     workers for tasks/actors leased chips."""
-    env = dict(os.environ)
-    # workers/agents must import this package from any cwd (the checkout is
-    # the install; there is no pip-installed copy to fall back on)
-    pkg_parent = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
-    if pkg_parent not in parts:
-        env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + parts)
+    env = package_env()
     env.update({
         "RMT_WORKER_ID": worker_id_hex,
         "RMT_NODE_ID": node_id_hex,
@@ -113,6 +139,39 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
             if var:
                 env.pop(var.strip(), None)
     return env
+
+
+def spawn_worker_process(env: Dict[str, str], config: Config,
+                         bootstrap: Optional[dict] = None,
+                         on_cold_bootstrap=None):
+    """Start one worker process: forked from the warm zygote when the
+    worker is CPU-platform (ms instead of a cold interpreter), else — and
+    whenever the zygote is unavailable — a fresh ``subprocess.Popen``.
+    TPU-platform workers always cold-spawn: the PJRT plugin must register
+    at interpreter startup, which a fork of the (deliberately
+    TPU-ignorant) zygote cannot provide.
+
+    ``bootstrap`` is a message the worker should process immediately at
+    startup (the dedicated-worker startup token, worker_pool.h:446). The
+    fork path hands it to the child in memory; the cold path cannot, so
+    ``on_cold_bootstrap`` is invoked BEFORE the process is created — the
+    caller queues the message for delivery at registration, race-free
+    because the worker cannot register before it exists."""
+    if config.worker_fork_server and env.get("JAX_PLATFORMS") == "cpu":
+        from . import zygote
+
+        z = zygote.get_global()
+        if z is not None:
+            proc = z.spawn(env, bootstrap)
+            if proc is not None:
+                return proc
+    if bootstrap is not None and on_cold_bootstrap is not None:
+        on_cold_bootstrap()
+    return subprocess.Popen(
+        [sys.executable, "-m",
+         "ray_memory_management_tpu.core.worker_main"],
+        env=env, close_fds=True,
+    )
 
 
 class NodeManager:
@@ -148,22 +207,26 @@ class NodeManager:
         self.free_chips: List[int] = list(range(total_chips))
 
     # -- worker pool ----------------------------------------------------------
-    def start_worker(self, dedicated: bool = False) -> WorkerHandle:
+    def start_worker(self, dedicated: bool = False,
+                     bootstrap: Optional[dict] = None,
+                     on_handle=None) -> WorkerHandle:
         """Spawn one worker process (WorkerPool::StartWorkerProcess analog,
-        worker_pool.h:427): a fresh interpreter launched with `-m ...worker_main`
-        that dials back into the runtime's Unix socket — the same
-        exec-then-connect handshake the raylet uses with its workers
-        (raylet_client.h:236 registration over the raylet socket)."""
+        worker_pool.h:427): a worker that dials back into the runtime's
+        Unix socket — the same exec-then-connect handshake the raylet uses
+        with its workers (raylet_client.h:236 registration over the raylet
+        socket). A ``bootstrap`` message rides the spawn itself when the
+        fork path is available (startup token, worker_pool.h:446), else it
+        is queued for delivery at registration.
+
+        The handle is registered — and ``on_handle`` (caller bookkeeping
+        that must be visible before any reply from the worker) runs —
+        BEFORE the process exists: a bootstrapped fork can answer within
+        milliseconds, racing any bookkeeping done after this returns."""
         worker_id = WorkerID.from_random()
         env = build_worker_env(worker_id.hex(), self.node_id.hex(),
                                self.store_name, self.socket_path,
                                self.authkey_hex, self.config)
-        proc = subprocess.Popen(
-            [sys.executable, "-m",
-             "ray_memory_management_tpu.core.worker_main"],
-            env=env, close_fds=True,
-        )
-        handle = WorkerHandle(worker_id, proc, self.node_id)
+        handle = WorkerHandle(worker_id, _PendingProc(), self.node_id)
         if dedicated:
             # claimed for an actor before registration: never enters the
             # idle pool (dedicated workers, worker_pool.h:446)
@@ -173,6 +236,17 @@ class NodeManager:
             if not dedicated:
                 self.starting += 1
         self._on_worker_started(handle)
+        if on_handle is not None:
+            on_handle(handle)
+
+        def queue_bootstrap():
+            # cold spawn: deliver through registration (pending_msgs are
+            # flushed when the worker dials in). Runs before the process
+            # exists, so the flush cannot have happened yet.
+            handle.pending_msgs.append(bootstrap)
+
+        handle.proc = spawn_worker_process(env, self.config, bootstrap,
+                                           queue_bootstrap)
         return handle
 
     def prestart(self, count: Optional[int] = None) -> None:

@@ -362,14 +362,13 @@ class RemoteNodeManager(NodeManager):
             state["event"].set()
 
     # ------------------------------------------------------------ worker pool
-    def start_worker(self, dedicated: bool = False) -> WorkerHandle:
+    def start_worker(self, dedicated: bool = False,
+                     bootstrap: Optional[dict] = None,
+                     on_handle=None) -> WorkerHandle:
+        # mirror NodeManager: register the handle and run the caller's
+        # bookkeeping BEFORE the spawn frame leaves — a bootstrapped fork
+        # on the agent can answer before this function returns
         worker_id = WorkerID.from_random()
-        self.channel_send({
-            "type": "start_worker",
-            "wid_hex": worker_id.hex(),
-            "dedicated": dedicated,
-            "env": {},
-        })
         handle = WorkerHandle(worker_id, RemoteProc(self, worker_id.binary()),
                               self.node_id)
         if dedicated:
@@ -379,6 +378,19 @@ class RemoteNodeManager(NodeManager):
             if not dedicated:
                 self.starting += 1
         self._on_worker_started(handle)
+        if on_handle is not None:
+            on_handle(handle)
+        msg = {
+            "type": "start_worker",
+            "wid_hex": worker_id.hex(),
+            "dedicated": dedicated,
+            "env": {},
+        }
+        if bootstrap is not None:
+            # the agent delivers it: in-memory via its zygote fork, or on
+            # the worker's dial-in if it had to cold-spawn
+            msg["bootstrap"] = bootstrap
+        self.channel_send(msg)
         return handle
 
     def worker_by_wid(self, wid: bytes) -> Optional[WorkerHandle]:

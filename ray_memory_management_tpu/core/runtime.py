@@ -54,9 +54,9 @@ from .task_spec import ActorCreationSpec, TaskSpec
 
 
 class _SendChannel:
-    """Per-connection outbound queue drained by a dedicated sender thread."""
+    """Per-connection outbound queue drained by the shared sender pool."""
 
-    __slots__ = ("conn", "handle", "q", "cond", "dead")
+    __slots__ = ("conn", "handle", "q", "cond", "dead", "scheduled")
 
     def __init__(self, conn, handle):
         self.conn = conn
@@ -64,6 +64,95 @@ class _SendChannel:
         self.q: deque = deque()
         self.cond = threading.Condition()
         self.dead = False
+        self.scheduled = False  # claimed by / queued for a pool thread
+
+
+class _SenderPool:
+    """Fixed thread pool draining per-connection send channels.
+
+    Replaces one-sender-thread-per-connection: at hundreds of live workers
+    (a Serve deployment, an actor-churn burst) per-connection threads cost
+    a thread spawn on every worker's first dispatch and a scheduler that
+    must juggle hundreds of mostly-idle threads. A channel with queued
+    messages is claimed by exactly ONE pool thread at a time (so writes to
+    a connection stay ordered), drained completely with back-to-back
+    messages coalesced into batch frames, then released. A worker that
+    stops draining its pipe pins only the one pool thread writing to it —
+    when all threads are pinned the pool grows (bounded) so stalled
+    consumers can never freeze everyone else's sends, and surplus threads
+    retire once idle."""
+
+    def __init__(self, runtime: "Runtime", base_threads: int = 4,
+                 max_threads: int = 64):
+        self._rt = runtime
+        self._cond = threading.Condition()
+        self._ready: deque = deque()  # scheduled channels awaiting a thread
+        self._base = base_threads
+        self._max = max_threads
+        self._threads = 0
+        self._idle = 0
+        with self._cond:
+            for _ in range(base_threads):
+                self._spawn_locked()
+
+    def _spawn_locked(self) -> None:
+        self._threads += 1
+        threading.Thread(target=self._loop, daemon=True,
+                         name="rmt-sender").start()
+
+    def enqueue(self, chan: _SendChannel, msg: dict) -> bool:
+        with chan.cond:
+            if chan.dead:
+                return False
+            chan.q.append(msg)
+            claim = not chan.scheduled
+            if claim:
+                chan.scheduled = True
+        if claim:
+            with self._cond:
+                self._ready.append(chan)
+                # isolation guarantee: if every pool thread is pinned on a
+                # blocked pipe (worker not draining), GROW rather than let
+                # one stalled consumer freeze cluster-wide sends; surplus
+                # threads retire after idling (see _loop). The cap bounds
+                # the pathological case of dozens of simultaneously
+                # wedged workers.
+                if self._idle == 0 and self._threads < self._max:
+                    self._spawn_locked()
+                else:
+                    self._cond.notify()
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._idle += 1
+                while not self._ready:
+                    if not self._cond.wait(timeout=10.0):
+                        if self._threads > self._base:
+                            # surplus grow-thread with nothing to do
+                            self._idle -= 1
+                            self._threads -= 1
+                            return
+                self._idle -= 1
+                chan = self._ready.popleft()
+            while True:
+                with chan.cond:
+                    if chan.dead or not chan.q:
+                        chan.scheduled = False
+                        chan.q.clear()
+                        break
+                    msgs = list(chan.q)
+                    chan.q.clear()
+                payload = msgs[0] if len(msgs) == 1 else {
+                    "type": "batch", "msgs": msgs}
+                if not self._rt._send_payload(chan.conn, payload):
+                    with chan.cond:
+                        chan.dead = True
+                        chan.q.clear()
+                        chan.scheduled = False
+                    self._rt._on_worker_death(chan.handle)
+                    break
 
 
 class _TaskRecord:
@@ -173,14 +262,20 @@ class Runtime:
         self._stop = threading.Event()
         self.pg_manager = None  # set by placement_group module on first use
 
-        # worker registration socket (workers dial back in after exec)
+        # worker registration socket (workers dial back in after exec).
+        # No HMAC challenge on the SAME-HOST worker socket: connecting
+        # requires write permission on the 0600 socket file, which is the
+        # same same-user trust boundary the challenge would enforce — and
+        # the challenge costs two extra round trips per worker connect,
+        # measurable in actor-churn bursts (the reference's raylet/plasma
+        # Unix sockets are likewise permission-trusted, raylet_client.h:236).
+        # The cluster authkey still guards everything that crosses hosts.
         self._authkey = os.urandom(16)
         self._socket_path = f"/tmp/{self.namespace}.sock"
         from multiprocessing.connection import Listener
 
-        self._listener = Listener(
-            self._socket_path, family="AF_UNIX", authkey=self._authkey
-        )
+        self._listener = Listener(self._socket_path, family="AF_UNIX")
+        os.chmod(self._socket_path, 0o600)
         self._workers_by_id: Dict[bytes, WorkerHandle] = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rmt-accept"
@@ -214,6 +309,7 @@ class Runtime:
 
         self._send_cond = threading.Condition()  # guards _send_channels
         self._send_channels: Dict[Any, _SendChannel] = {}
+        self._sender_pool = _SenderPool(self)
         self._router = threading.Thread(
             target=self._router_loop, daemon=True, name="rmt-router"
         )
@@ -257,7 +353,7 @@ class Runtime:
             node_id, node_res, store_name, self.config,
             on_worker_started=self._register_worker,
             socket_path=self._socket_path,
-            authkey_hex=self._authkey.hex(),
+            authkey_hex="",  # permission-trusted worker socket (see above)
         )
         with self._lock:
             self.nodes[node_id] = nm
@@ -340,6 +436,12 @@ class Runtime:
             except (EOFError, OSError):
                 conn.close()
                 continue
+            # a bootstrapped worker can reply so fast that its sender
+            # coalesces ready + actor_ready into one batch frame
+            trailing = []
+            if msg.get("type") == "batch" and msg["msgs"]:
+                trailing = msg["msgs"][1:]
+                msg = msg["msgs"][0]
             if msg.get("type") != "ready":
                 conn.close()
                 continue
@@ -359,6 +461,11 @@ class Runtime:
                 nm.on_worker_ready(handle)
             for m in pending:
                 self._send(handle, m)
+            for m in trailing:  # replies that rode the ready batch
+                try:
+                    self._handle_worker_message(handle, m)
+                except Exception:  # noqa: BLE001 — never kill the accept
+                    pass           # loop on one bad frame
             self._wakeup()
             self._pump()
 
@@ -576,10 +683,8 @@ class Runtime:
         pipe wakes its process — on a loaded host that is two context
         switches — so the write count, not the byte count, is the cost
         model; the calling thread never writes inline under load, it keeps
-        producing while the sender drains. One sender thread PER
-        connection: a worker that stops draining its pipe (long task,
-        full buffer) can only stall its own deliveries, never another
-        worker's."""
+        producing while the pool drains (see _SenderPool for the
+        slow-consumer isolation story)."""
         with self._lock:
             if handle.conn is None:
                 if handle.alive():
@@ -594,34 +699,7 @@ class Runtime:
                     return False  # conn already swept by a death event
                 chan = _SendChannel(conn, handle)
                 self._send_channels[conn] = chan
-                threading.Thread(
-                    target=self._conn_sender_loop, args=(chan,),
-                    daemon=True, name="rmt-sender",
-                ).start()
-        with chan.cond:
-            if chan.dead:
-                return False
-            chan.q.append(msg)
-            chan.cond.notify()
-        return True
-
-    def _conn_sender_loop(self, chan: "_SendChannel") -> None:
-        while True:
-            with chan.cond:
-                while not chan.q and not chan.dead:
-                    chan.cond.wait()
-                if chan.dead and not chan.q:
-                    return
-                msgs = list(chan.q)
-                chan.q.clear()
-            payload = msgs[0] if len(msgs) == 1 else {
-                "type": "batch", "msgs": msgs}
-            if not self._send_payload(chan.conn, payload):
-                with chan.cond:
-                    chan.dead = True
-                    chan.q.clear()
-                self._on_worker_death(chan.handle)
-                return
+        return self._sender_pool.enqueue(chan, msg)
 
     def _send_payload(self, conn, payload: dict) -> bool:
         lock = self._conn_send_locks.get(conn)
@@ -1400,11 +1478,6 @@ class Runtime:
             chips = nm.take_chips(n_chips)
         # PG actors: the bundle reservation already deducted node resources
         lease = Resources({}) if spec.placement is not None else req
-        handle = nm.start_worker(dedicated=True)
-        nm.dedicate_to_actor(handle, spec.actor_id, lease, chips)
-        info.handle = handle
-        info.record.node_id = node_id
-        info.record.worker_id = handle.worker_id
         msg = {
             "type": "create_actor", "actor_id": spec.actor_id,
             "cls_id": spec.cls_id, "name": spec.name,
@@ -1412,16 +1485,30 @@ class Runtime:
             "kwargs": {k: self._finalize_arg(v)
                        for k, v in spec.kwargs.items()},
             "max_concurrency": spec.max_concurrency,
+            # the blob always rides along: this worker is brand new
+            "cls_blob": self.cls_blobs[spec.cls_id],
         }
         if spec.runtime_env:
             msg["runtime_env"] = spec.runtime_env
-        if spec.cls_id not in handle.known_classes:
-            msg["cls_blob"] = self.cls_blobs[spec.cls_id]
-            handle.known_classes.add(spec.cls_id)
         if chips is not None:
             msg["visible_chips"] = ",".join(str(c) for c in chips)
-        if not self._send(handle, msg):
-            self._on_worker_death(handle)
+
+        def on_handle(h):
+            # runs BEFORE the spawn: a bootstrapped fork can reply
+            # actor_ready within milliseconds, so every lookup that reply
+            # touches (dedication, info.handle, the record) must already
+            # be in place
+            h.known_classes.add(spec.cls_id)
+            nm.dedicate_to_actor(h, spec.actor_id, lease, chips)
+            info.handle = h
+            info.record.node_id = node_id
+            info.record.worker_id = h.worker_id
+
+        # the create message is the spawn's startup token (dedicated
+        # worker + assigned task, worker_pool.h:446): the fork path hands
+        # it to the child in memory — no registration round trip on the
+        # actor-creation critical path
+        nm.start_worker(dedicated=True, bootstrap=msg, on_handle=on_handle)
 
     def _on_actor_created(self, handle: WorkerHandle, msg: dict) -> None:
         actor_id = msg["actor_id"]
@@ -2510,6 +2597,9 @@ class Runtime:
                 nm.shutdown(unlink_store=True)
             except Exception:
                 pass
+        from . import zygote as _zygote
+
+        _zygote.shutdown_global()
         for cli in self._store_clients.values():
             if isinstance(cli, StoreClient):
                 try:

@@ -779,6 +779,15 @@ class Worker:
         # handshake; the runtime binds this connection to our WorkerHandle)
         self.sender.send({"type": "ready", "worker_id": self.worker_id,
                           "node_id": self.node_id, "pid": os.getpid()})
+        # a bootstrap message (the reference's dedicated-worker startup
+        # token carrying the assigned actor, worker_pool.h:446) was handed
+        # to us AT SPAWN — process it without waiting for the owner's
+        # registration round trip. Ordering is safe: the owner sends actor
+        # tasks only after our actor_ready reply.
+        boot = getattr(self, "bootstrap_msg", None)
+        if boot is not None:
+            self.bootstrap_msg = None
+            self._dispatch(boot)
         while not self._shutdown.is_set():
             try:
                 msg = self.conn.recv()

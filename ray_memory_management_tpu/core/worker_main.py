@@ -12,13 +12,20 @@ from __future__ import annotations
 import os
 from multiprocessing.connection import Client
 
+# Set by the zygote in a forked child before calling main(): a message
+# (e.g. create_actor) the worker processes immediately after registering,
+# without waiting for the owner to deliver it (startup-token analog).
+_bootstrap = None
+
 
 def main() -> None:
     worker_id = bytes.fromhex(os.environ["RMT_WORKER_ID"])
     node_id = bytes.fromhex(os.environ["RMT_NODE_ID"])
     store_name = os.environ["RMT_STORE_NAME"]
     socket_path = os.environ["RMT_SOCKET"]
-    authkey = bytes.fromhex(os.environ["RMT_AUTHKEY"])
+    # empty RMT_AUTHKEY = permission-trusted local socket (no HMAC
+    # challenge; the socket file is 0600, same trust boundary)
+    authkey = bytes.fromhex(os.environ["RMT_AUTHKEY"]) or None
     inline_limit = int(os.environ["RMT_INLINE_LIMIT"])
 
     import time
@@ -38,7 +45,18 @@ def main() -> None:
         return
     from .worker import Worker
 
-    Worker(conn, worker_id, node_id, store_name, inline_limit).run()
+    w = Worker(conn, worker_id, node_id, store_name, inline_limit)
+    if _bootstrap is not None:
+        w.bootstrap_msg = _bootstrap
+    if os.environ.get("RMT_WORKER_PROFILE"):
+        import cProfile
+        import threading
+
+        pr = cProfile.Profile()
+        pr.enable()
+        path = os.environ["RMT_WORKER_PROFILE"] + f".{os.getpid()}"
+        threading.Timer(2.0, lambda: pr.dump_stats(path)).start()
+    w.run()
 
 
 if __name__ == "__main__":

@@ -345,6 +345,66 @@ def forward_with_cache(params, tokens, cache, offset, cfg: TransformerConfig):
     return logits, {"k": k_new, "v": v_new}
 
 
+def forward_with_cache_rows(params, tokens, cache, offsets,
+                            cfg: TransformerConfig):
+    """Incremental forward with PER-ROW positions: row ``i`` of ``tokens``
+    [B, S] occupies absolute positions [offsets[i], offsets[i]+S) of its
+    cache row. This is the kernel continuous batching needs — rows of one
+    decode batch sit at different sequence depths (one request is 900
+    tokens in, its neighbor just prefilled) — and it is also the exact
+    fix for the padded-batch approximation: each row attends only to its
+    own true history (mask per row), with rope/positional phases taken
+    from its own offset. Returns (logits [B, S, V] fp32, updated cache).
+    """
+    B, S = tokens.shape
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    T = cache["k"].shape[3]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    positions = offsets[:, None] + jnp.arange(S)[None, :]     # [B, S]
+    key_pos = jnp.arange(T)                                   # [T]
+    # per-row causal-vs-cache mask: row i's query at absolute pos p sees
+    # key slots <= p of row i's cache only
+    mask = key_pos[None, None, :] <= positions[:, :, None]    # [B, S, T]
+
+    def scan_body(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+
+        def cached_attn(q, k, v):
+            kt = k.transpose(0, 2, 1, 3)                      # [B,Hkv,S,Dh]
+            vt = v.transpose(0, 2, 1, 3)
+            write = jax.vmap(
+                lambda c, u, o: lax.dynamic_update_slice(c, u, (0, o, 0)))
+            kc = write(k_cache, kt, offsets)
+            vc = write(v_cache, vt, offsets)
+            kk, vv = kc, vc
+            if Hkv != H:
+                rep = H // Hkv
+                kk = jnp.repeat(kk, rep, axis=1)
+                vv = jnp.repeat(vv, rep, axis=1)
+            qh = q.transpose(0, 2, 1, 3)                      # [B, H, S, Dh]
+            scores = jnp.einsum(
+                "bhsd,bhtd->bhst", qh, kk,
+                preferred_element_type=jnp.float32) * (Dh ** -0.5)
+            scores = jnp.where(mask[:, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            o = jnp.einsum("bhst,bhtd->bhsd", probs, vv)
+            return o.transpose(0, 2, 1, 3), (kc, vc)
+
+        x, (kc, vc) = apply_block(x, layer, cfg, attn_fn=cached_attn,
+                                  positions=positions)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["final_ln"])
+    logits = lax.dot_general(
+        x, params["lm_head"].astype(cfg.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
 import functools
 
 

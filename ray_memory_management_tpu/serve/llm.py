@@ -118,6 +118,234 @@ def _bytes_tokenize(text: str, vocab_size: int) -> List[int]:
     return [2 + (b % (vocab_size - 2)) for b in text.encode()]
 
 
+class ContinuousBatcher:
+    """Decode-step-granular request scheduler (continuous batching).
+
+    The DynamicBatcher above is a whole-batch barrier: every request in a
+    batch decodes the full ``max_new_tokens`` before ANY new request joins,
+    so under streaming arrivals the chip idles on retired rows and new
+    arrivals queue behind the stragglers. This engine schedules at decode-
+    step granularity over a fixed slot table (the vLLM/Orca iteration-level
+    scheduling idea, TPU-shaped):
+
+      - a KV cache of ``max_slots`` rows lives across requests; a new
+        request is PREFILLED into a free row the moment one exists
+        (per-bucket compiled prefill writes its prompt's KV at positions
+        [0, len));
+      - every engine iteration runs ONE single-token decode step over all
+        occupied rows (one compiled program, static [max_slots, 1] shape,
+        per-row offsets via models/gpt.forward_with_cache_rows);
+      - a row that reaches its request's token budget retires immediately
+        and its slot admits the next queued request at the very next step.
+
+    Per-row offsets also make mixed-length batches EXACT: each row attends
+    only to its own true history with its own rope phases — the padded-
+    batch approximation (a short row conditioning on its repeated final
+    token) is gone.
+    """
+
+    def __init__(self, params, cfg, max_slots: int = 8,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 pad_multiple: int = 64, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models import gpt
+
+        self._jax, self._jnp, self._np, self._gpt = jax, jnp, np, gpt
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.pad_multiple = pad_multiple
+        self._key = jax.random.PRNGKey(seed)
+        self._cache = gpt.init_kv_cache(cfg, max_slots, cfg.max_seq)
+        self._prefill_cache: Dict[int, Any] = {}  # bucket -> compiled fn
+
+        def _sample(logits, key):
+            if self.temperature > 0:
+                return jax.random.categorical(key, logits / self.temperature)
+            return jnp.argmax(logits, axis=-1)
+
+        def step_fn(params, cache, last, offsets, key):
+            logits, cache = gpt.forward_with_cache_rows(
+                params, last[:, None], cache, offsets, cfg)
+            return cache, _sample(logits[:, 0], key)
+
+        # donate the cache so each step updates it in place on device
+        # instead of allocating a fresh multi-hundred-MB copy
+        self._step = jax.jit(step_fn, donate_argnums=(1,))
+        self._sample = _sample
+
+        # slot state (host side)
+        self._slot_pending: List[Optional[_Pending]] = [None] * max_slots
+        self._slot_offset = np.zeros(max_slots, np.int32)
+        self._slot_last = np.ones(max_slots, np.int32)
+        self._slot_out: List[List[int]] = [[] for _ in range(max_slots)]
+        self._slot_budget = np.zeros(max_slots, np.int32)
+
+        self._q: List[_Pending] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self.steps = 0  # decode steps executed (the "batches" analog)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, tokens: List[int], timeout: float = 300.0,
+               max_new_tokens: Optional[int] = None):
+        """Blocking generate. ``max_new_tokens`` may be set PER REQUEST
+        (capped by the engine default): with step-granular scheduling a
+        short request retires early and frees its slot — under the old
+        whole-batch barrier every request paid the longest budget."""
+        budget = self.max_new_tokens if max_new_tokens is None else \
+            max(1, min(int(max_new_tokens), self.max_new_tokens))
+        p = _Pending((list(tokens), budget))
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("engine closed")
+            self._q.append(p)
+            self._cond.notify()
+        if not p.event.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def close(self) -> None:
+        """Stop the engine, failing queued AND slot-resident requests
+        promptly with "engine closed" (never leaving a caller to ride out
+        its full submit timeout). Slot state belongs to the engine thread,
+        so its _stop exit path fails the resident rows; this thread only
+        drains the queue."""
+        with self._cond:
+            self._stop = True
+            drained = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for p in drained:
+            p.error = RuntimeError("engine closed")
+            p.event.set()
+
+    # -- engine side ----------------------------------------------------------
+    def _prefill_fn(self, bucket: int):
+        jax, jnp, gpt, cfg = self._jax, self._jnp, self._gpt, self.cfg
+        fn = self._prefill_cache.get(bucket)
+        if fn is not None:
+            return fn
+
+        def prefill(params, cache, tokens, row, true_len, key):
+            lax = jax.lax
+            row_cache = {
+                "k": lax.dynamic_slice_in_dim(cache["k"], row, 1, axis=1),
+                "v": lax.dynamic_slice_in_dim(cache["v"], row, 1, axis=1),
+            }
+            logits, row_cache = gpt.forward_with_cache_rows(
+                params, tokens, row_cache, jnp.zeros((1,), jnp.int32), cfg)
+            cache = {
+                "k": lax.dynamic_update_slice_in_dim(
+                    cache["k"], row_cache["k"], row, axis=1),
+                "v": lax.dynamic_update_slice_in_dim(
+                    cache["v"], row_cache["v"], row, axis=1),
+            }
+            first = self._sample(logits[0, true_len - 1][None], key)[0]
+            return cache, first
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_cache[bucket] = fn
+        return fn
+
+    def _admit(self, p: _Pending, row: int) -> None:
+        np, jnp = self._np, self._jnp
+        toks, budget = p.item
+        limit = self.cfg.max_seq - self.max_new_tokens
+        toks = toks[-limit:]
+        bucket = max(self.pad_multiple,
+                     ((len(toks) + self.pad_multiple - 1)
+                      // self.pad_multiple) * self.pad_multiple)
+        bucket = min(bucket, limit)
+        arr = np.ones((1, bucket), np.int32)
+        arr[0, : len(toks)] = toks  # right-pad junk is invisible: the
+        # per-row mask stops at true_len and decode overwrites those slots
+        self._key, sub = self._jax.random.split(self._key)
+        self._cache, first = self._prefill_fn(bucket)(
+            self.params, self._cache, jnp.asarray(arr),
+            jnp.int32(row), jnp.int32(len(toks)), sub)
+        self._slot_pending[row] = p
+        self._slot_offset[row] = len(toks)
+        self._slot_last[row] = int(first)
+        self._slot_out[row] = [int(first)]
+        self._slot_budget[row] = budget - 1
+
+    def _retire(self, row: int) -> None:
+        p = self._slot_pending[row]
+        self._slot_pending[row] = None
+        self._slot_offset[row] = 0
+        self._slot_last[row] = 1
+        if p is not None:
+            p.result = self._slot_out[row]
+            p.event.set()
+
+    def _loop(self) -> None:
+        jnp, np = self._jnp, self._np
+        while True:
+            with self._cond:
+                while (not self._stop and not self._q
+                       and all(p is None for p in self._slot_pending)):
+                    self._cond.wait(timeout=1.0)
+                if self._stop:
+                    # fail slot-resident requests too: close() cannot
+                    # touch slot state (it races this thread), so the
+                    # exit path owns that cleanup
+                    victims = [p for p in self._slot_pending
+                               if p is not None]
+                    self._slot_pending = [None] * self.max_slots
+                    for p in victims:
+                        p.error = RuntimeError("engine closed")
+                        p.event.set()
+                    return
+                admits = []
+                for row in range(self.max_slots):
+                    if self._slot_pending[row] is None and self._q:
+                        admits.append((self._q.pop(0), row))
+            try:
+                for p, row in admits:
+                    self._admit(p, row)
+                    if self._slot_budget[row] <= 0:
+                        self._retire(row)  # max_new_tokens == 1
+                active = [r for r in range(self.max_slots)
+                          if self._slot_pending[r] is not None]
+                if not active:
+                    continue
+                self._key, sub = self._jax.random.split(self._key)
+                self._cache, nxt = self._step(
+                    self.params, self._cache,
+                    jnp.asarray(self._slot_last),
+                    jnp.asarray(self._slot_offset), sub)
+                nxt = np.asarray(nxt)
+                self.steps += 1
+                for r in active:
+                    tok = int(nxt[r])
+                    self._slot_out[r].append(tok)
+                    self._slot_last[r] = tok
+                    self._slot_offset[r] += 1
+                    self._slot_budget[r] -= 1
+                    if self._slot_budget[r] <= 0:
+                        self._retire(r)
+            except BaseException as e:  # noqa: BLE001 — fail loudly to
+                with self._cond:        # every parked caller, keep serving
+                    victims = ([p for p in self._slot_pending
+                                if p is not None] + self._q)
+                    self._slot_pending = [None] * self.max_slots
+                    self._q.clear()
+                for p in victims:
+                    p.error = e
+                    p.event.set()
+
+
 class LLMServer:
     """Deployment class: KV-cached batched generation on one chip.
 
@@ -130,7 +358,8 @@ class LLMServer:
                  max_new_tokens: int = 32,
                  temperature: float = 0.0,
                  pad_multiple: int = 64,
-                 seed: int = 0):
+                 seed: int = 0,
+                 batching: str = "continuous"):
         import jax
 
         from ..models import gpt
@@ -147,11 +376,25 @@ class LLMServer:
         self.temperature = temperature
         self.pad_multiple = pad_multiple
         self.max_batch_size = max_batch_size
+        self.seed = seed
         self._key = jax.random.PRNGKey(seed + 1)
         self._stats = {"requests": 0, "batches": 0, "generated_tokens": 0}
-        self._batcher = DynamicBatcher(
-            self._run_batch, max_batch_size=max_batch_size,
-            batch_wait_timeout_s=batch_wait_timeout_s)
+        self.batching = batching
+        if batching == "continuous":
+            # decode-step-granular join/leave + exact per-row positions
+            self._engine = ContinuousBatcher(
+                self.params, self.cfg, max_slots=max_batch_size,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                pad_multiple=pad_multiple, seed=seed + 1)
+            self._batcher = None
+        elif batching == "barrier":
+            # legacy whole-batch mode (kept for A/B benchmarking)
+            self._engine = None
+            self._batcher = DynamicBatcher(
+                self._run_batch, max_batch_size=max_batch_size,
+                batch_wait_timeout_s=batch_wait_timeout_s)
+        else:
+            raise ValueError(f"unknown batching mode: {batching!r}")
 
     # -- config ---------------------------------------------------------------
     def reconfigure(self, user_config: Optional[dict]) -> None:
@@ -164,17 +407,29 @@ class LLMServer:
                 f"max_new_tokens={new_tokens} leaves no room for a "
                 f"{self.pad_multiple}-token prompt bucket within "
                 f"max_seq={self.cfg.max_seq}")
+        new_temp = float(user_config.get("temperature", self.temperature))
+        changed = (new_tokens != self.max_new_tokens
+                   or new_temp != self.temperature)
         self.max_new_tokens = new_tokens
-        self.temperature = float(user_config.get(
-            "temperature", self.temperature))
+        self.temperature = new_temp
+        if self._engine is not None and changed:
+            # temperature is baked into the engine's compiled sampler at
+            # trace time (and the token budget into its slot accounting):
+            # swap in a fresh engine rather than mutating a live one
+            old = self._engine
+            self._engine = ContinuousBatcher(
+                self.params, self.cfg, max_slots=self.max_batch_size,
+                max_new_tokens=new_tokens, temperature=new_temp,
+                pad_multiple=self.pad_multiple, seed=self.seed + 1)
+            old.close()
 
     # -- request surface ------------------------------------------------------
     def __call__(self, request: Any = None) -> Dict[str, Any]:
         """HTTP entrypoint: {"tokens": [...]} or {"text": "..."}. Returns
-        {"tokens": [...]}. The continuation length is the deployment's
-        ``max_new_tokens`` (per-request overrides would defeat the
-        one-compiled-program-per-bucket batching; retune it via
-        ``user_config`` reconfigure instead)."""
+        {"tokens": [...]}. An optional per-request "max_new_tokens"
+        (capped by the deployment default) is honored in continuous mode —
+        step-granular scheduling makes short requests retire early; in
+        barrier mode the whole batch decodes the deployment default."""
         if isinstance(request, str):
             request = {"text": request}
         request = request or {}
@@ -184,12 +439,23 @@ class LLMServer:
                                      self.cfg.vocab_size)
         if not tokens:
             tokens = [1]
-        out = self.generate(tokens)
+        out = self.generate(tokens,
+                            max_new_tokens=request.get("max_new_tokens"))
         return {"tokens": out, "prompt_len": len(tokens)}
 
-    def generate(self, tokens: Sequence[int]) -> List[int]:
-        """Generate ``max_new_tokens`` continuation ids for one prompt
-        (batched under the hood with whatever arrives concurrently)."""
+    def generate(self, tokens: Sequence[int],
+                 max_new_tokens: Optional[int] = None) -> List[int]:
+        """Generate continuation ids for one prompt (batched under the
+        hood with whatever arrives concurrently). ``max_new_tokens`` can
+        be set per request in continuous mode (capped by the deployment
+        default); barrier mode always decodes the full default."""
+        if self._engine is not None:
+            out = self._engine.submit(list(tokens),
+                                      max_new_tokens=max_new_tokens)
+            self._stats["requests"] += 1
+            self._stats["generated_tokens"] += len(out)
+            self._stats["batches"] = self._engine.steps
+            return out
         return self._batcher.submit(list(tokens))
 
     def stats(self) -> dict:
@@ -259,4 +525,5 @@ def llm_deployment(preset: str = "gpt2-small",
     ).bind(preset=preset, **kwargs)
 
 
-__all__ = ["DynamicBatcher", "LLMServer", "llm_deployment"]
+__all__ = ["ContinuousBatcher", "DynamicBatcher", "LLMServer",
+           "llm_deployment"]

@@ -33,19 +33,56 @@ BASELINE = {
 }
 
 
-def _timeit(fn: Callable[[int], None], n: int, warmup: int = 1) -> float:
+def _timeit(fn: Callable[[int], None], n: int, warmup: int = 1,
+            trials: int = 3) -> "_Row":
+    """Run ``fn(n)`` ``trials`` times after a warmup; report the MEDIAN
+    rate with min/max dispersion. Single-trial numbers made every perf
+    regression unfalsifiable — a swing could always be noise; the median
+    of three with recorded spread is cheap and decidable."""
     for _ in range(warmup):
         fn(max(1, n // 10))
-    t0 = time.perf_counter()
-    fn(n)
-    dt = time.perf_counter() - t0
-    return n / dt
+    rates = []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        fn(n)
+        dt = time.perf_counter() - t0
+        rates.append(n / dt)
+    rates.sort()
+    return _Row(rates[len(rates) // 2], rates[0], rates[-1], len(rates))
+
+
+class _Row:
+    """A measured rate with dispersion. Behaves as its median (float
+    arithmetic, formatting) so existing consumers keep working."""
+
+    __slots__ = ("median", "min", "max", "trials")
+
+    def __init__(self, median: float, lo: float, hi: float, trials: int):
+        self.median = median
+        self.min = lo
+        self.max = hi
+        self.trials = trials
+
+    def scaled(self, k: float) -> "_Row":
+        return _Row(self.median * k, self.min * k, self.max * k,
+                    self.trials)
+
+    def stats(self) -> Dict[str, float]:
+        return {"median": round(self.median, 4), "min": round(self.min, 4),
+                "max": round(self.max, 4), "trials": self.trials}
+
+    def __float__(self) -> float:
+        return self.median
 
 
 def run_microbenchmark(scale: float = 1.0,
-                       select: Optional[list] = None) -> Dict[str, float]:
+                       select: Optional[list] = None,
+                       collect_stats: Optional[Dict] = None
+                       ) -> Dict[str, float]:
     """Run the suite against the current runtime; returns {metric: ops/s}
-    (or GB/s for put_gigabytes)."""
+    (or GB/s for put_gigabytes) — medians of 3 trials. Pass
+    ``collect_stats`` (a dict) to also receive per-metric
+    median/min/max/trials dispersion."""
     import ray_memory_management_tpu as rmt
 
     results: Dict[str, float] = {}
@@ -163,7 +200,8 @@ def run_microbenchmark(scale: float = 1.0,
                 del r
 
         chunks_per_s = _timeit(put_gb, n_chunks)
-        results["single_client_put_gigabytes"] = chunks_per_s * 16 / 1024
+        results["single_client_put_gigabytes"] = chunks_per_s.scaled(
+            16 / 1024)
 
     if want("single_client_get_object_containing_10k_refs"):
         inner = [rmt.put(i) for i in range(10_000)]
@@ -209,7 +247,7 @@ def run_microbenchmark(scale: float = 1.0,
                         cb.put_object(blob)
 
                 per_s = _timeit(client_puts, max(4, int(32 * scale)))
-                results["client__put_gigabytes"] = per_s * 4 / 1024
+                results["client__put_gigabytes"] = per_s.scaled(4 / 1024)
 
             if want("client__1_1_actor_calls_sync"):
                 actor = Sink.remote()
@@ -229,7 +267,11 @@ def run_microbenchmark(scale: float = 1.0,
             cb.close()
             server.close()
 
-    return results
+    if collect_stats is not None:
+        for k, v in results.items():
+            collect_stats[k] = (v.stats() if isinstance(v, _Row)
+                                else {"median": v})
+    return {k: float(v) for k, v in results.items()}
 
 
 def vs_baseline(results: Dict[str, float]) -> Dict[str, float]:

@@ -18,11 +18,14 @@ def test_workload_survives_random_node_kill():
         def produce(i):
             import time
 
-            time.sleep(0.05)
+            time.sleep(0.2)
             return np.full(200_000, float(i), np.float64)  # store object
 
-        refs = [produce.remote(i) for i in range(24)]
-        killer = NodeKiller(rt, interval_s=0.4, max_kills=1).start()
+        # sleeps sized so the workload is still in flight when the killer
+        # fires (worker spawns got fast enough that a short workload could
+        # drain before a longer interval)
+        refs = [produce.remote(i) for i in range(36)]
+        killer = NodeKiller(rt, interval_s=0.3, max_kills=1).start()
         try:
             arrs = rmt.get(refs, timeout=300)
         finally:
