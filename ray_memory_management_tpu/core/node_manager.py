@@ -247,6 +247,15 @@ class NodeManager:
 
         handle.proc = spawn_worker_process(env, self.config, bootstrap,
                                            queue_bootstrap)
+        if not self.alive:
+            # remove_node ran while we were spawning: its terminate loop
+            # saw only the _PendingProc placeholder, so the real process
+            # would outlive its node — kill it; the runtime's unborn-worker
+            # sweep then reports the death
+            try:
+                handle.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
         return handle
 
     def prestart(self, count: Optional[int] = None) -> None:

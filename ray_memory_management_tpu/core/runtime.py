@@ -447,7 +447,10 @@ class Runtime:
                 continue
             with self._lock:
                 handle = self._workers_by_id.get(msg["worker_id"])
-                if handle is None:
+                if handle is None or handle.death_processed:
+                    # unknown, or the unborn-worker sweep already declared
+                    # it dead — binding the conn would put a corpse back
+                    # in the idle pool
                     conn.close()
                     continue
                 handle.conn = conn
@@ -1700,6 +1703,9 @@ class Runtime:
                     handle.conn not in self._conn_handles:
                 return  # conn already swept by an earlier death event
             handle.death_processed = True
+            # a late 'ready' dial-in must not resurrect this handle (the
+            # accept loop checks death_processed too, belt-and-braces)
+            self._workers_by_id.pop(handle.worker_id.binary(), None)
             dead_conn = handle.conn
             if dead_conn is not None:
                 self._conn_handles.pop(dead_conn, None)
@@ -1830,6 +1836,20 @@ class Runtime:
                             sweep()  # expire ensure_resident pins
                         except Exception:
                             pass
+            # reap workers that died WITHOUT ever dialing in (killed by
+            # remove_node mid-spawn, import crash, OOM at startup): no
+            # pipe means no EOF, so without this sweep their dedicated
+            # actors hang at PENDING_CREATION forever and callers ride out
+            # their full get() timeout (the node agent runs the same sweep
+            # in its _reap_loop; the raylet's starting-worker timeout is
+            # the reference analog, worker_pool.h:427)
+            for nm in nodes:
+                with nm._lock:  # nm.workers is guarded by the NODE's lock
+                    unborn = [h for h in nm.workers.values()
+                              if h.conn is None and not h.death_processed]
+                for h in unborn:
+                    if h.proc.poll() is not None:
+                        self._on_worker_death(h)
             for node_id in self.gcs.check_heartbeats(timeout):
                 self.remove_node(node_id)
             self._stop.wait(interval)

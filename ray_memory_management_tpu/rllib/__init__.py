@@ -8,8 +8,15 @@ weights broadcast through the object store.
 
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
-from .env import CartPole, Env, make_env, register_env  # noqa: F401
+from .env import (  # noqa: F401
+    CartPole,
+    Env,
+    Pendulum,
+    make_env,
+    register_env,
+)
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
+from .sac import SAC, SACConfig  # noqa: F401
 from .rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

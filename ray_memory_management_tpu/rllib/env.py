@@ -84,8 +84,65 @@ class CartPole(Env):
                 {})
 
 
+class Pendulum(Env):
+    """Classic inverted-pendulum swing-up (the dynamics of gym
+    Pendulum-v1: obs [cos th, sin th, thdot], one torque action in
+    [-2, 2], reward -(th^2 + 0.1 thdot^2 + 0.001 u^2), 200-step episodes,
+    never terminates). The standard continuous-control smoke env — the
+    reference's SAC regression runs on it
+    (rllib/tuned_examples/sac/pendulum-sac.yaml)."""
+
+    observation_dim = 3
+    num_actions = 0          # continuous: no discrete action set
+    action_dim = 1
+    action_bound = 2.0
+    max_episode_steps = 200
+
+    G = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = max_episode_steps
+        self._rng = np.random.default_rng(0)
+        self._th = 0.0
+        self._thdot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th), self._thdot],
+                        np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.action_bound, self.action_bound))
+        th, thdot = self._th, self._thdot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        reward = -(norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2)
+        thdot = thdot + (
+            3 * self.G / (2 * self.LENGTH) * np.sin(th)
+            + 3.0 / (self.MASS * self.LENGTH ** 2) * u) * self.DT
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + thdot * self.DT
+        self._th, self._thdot = th, thdot
+        self._steps += 1
+        truncated = self._steps >= self.max_episode_steps
+        return self._obs(), float(reward), False, truncated, {}
+
+
 ENV_REGISTRY: Dict[str, Callable[..., Env]] = {
     "CartPole": CartPole,
+    "Pendulum": Pendulum,
 }
 
 

@@ -1,0 +1,425 @@
+"""SAC: soft actor-critic for continuous control.
+
+The reference's SAC (rllib/algorithms/sac/sac.py — config + training_step
+wiring; rllib/algorithms/sac/sac_tf_policy.py:268 the twin-Q + squashed-
+Gaussian losses; target entropy auto-tuning per Haarnoja et al. 2018).
+TPU-first shape, like dqn.py: the ENTIRE update — actor forward, twin-Q
+targets with the entropy bonus, three losses (critic, actor, temperature),
+Adam on each, and the polyak target-network update — is one jit'd XLA
+program; stochastic rollouts run on CPU actors; the replay buffer is
+host-side numpy feeding one contiguous minibatch per update.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import NEXT_OBS
+from .env import make_env
+from .models import mlp_apply, mlp_init, params_from_numpy, params_to_numpy
+from .replay import ReplayBuffer
+from .rollout_worker import WorkerSet
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+def sac_init(rng, obs_dim: int, act_dim: int, hidden=(64, 64)):
+    """Policy emits (mean, log_std) per action dim; twin Q critics score
+    (obs, action) pairs (sac_tf_policy.py's SquashedGaussian + twin_q)."""
+    import jax
+
+    k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+    return {
+        "pi": mlp_init(k_pi, [obs_dim, *hidden, 2 * act_dim]),
+        "q1": mlp_init(k_q1, [obs_dim + act_dim, *hidden, 1]),
+        "q2": mlp_init(k_q2, [obs_dim + act_dim, *hidden, 1]),
+    }
+
+
+def pi_sample(params, obs, key, bound: float):
+    """Squashed-Gaussian sample: a = bound * tanh(mu + sigma eps), with
+    the tanh change-of-variables log-prob correction."""
+    import jax
+    import jax.numpy as jnp
+
+    out = mlp_apply(params["pi"], obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    # N(pre; mu, std) log-density, then tanh correction (numerically
+    # stable form: log(1 - tanh^2 x) = 2(log 2 - x - softplus(-2x)))
+    logp = jnp.sum(
+        -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - 2 * (jnp.log(2.0) - pre - jax.nn.softplus(-2 * pre)),
+        axis=-1)
+    return bound * a, logp
+
+
+def q_value(params, which: str, obs, act):
+    import jax.numpy as jnp
+
+    return mlp_apply(params[which], jnp.concatenate([obs, act], -1))[..., 0]
+
+
+def make_sac_update(pi_opt, q_opt, a_opt, gamma: float, tau: float,
+                    target_entropy: float, bound: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def critic_loss(params, target_params, log_alpha, batch, key):
+        obs, act, rew, nxt, done = batch
+        next_a, next_logp = pi_sample(params, nxt, key, bound)
+        tq = jnp.minimum(q_value(target_params, "q1", nxt, next_a),
+                         q_value(target_params, "q2", nxt, next_a))
+        alpha = jnp.exp(log_alpha)
+        target = rew + gamma * (1.0 - done) * jax.lax.stop_gradient(
+            tq - alpha * next_logp)
+        q1 = q_value(params, "q1", obs, act)
+        q2 = q_value(params, "q2", obs, act)
+        loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+        return loss, q1.mean()
+
+    def actor_loss(pi_params, params, log_alpha, obs, key):
+        merged = {**params, "pi": pi_params}
+        a, logp = pi_sample(merged, obs, key, bound)
+        q = jnp.minimum(q_value(params, "q1", obs, a),
+                        q_value(params, "q2", obs, a))
+        alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+        return jnp.mean(alpha * logp - q), logp
+
+    def alpha_loss(log_alpha, logp):
+        # temperature auto-tuning toward the entropy target
+        return -jnp.mean(
+            log_alpha * jax.lax.stop_gradient(logp + target_entropy))
+
+    @jax.jit
+    def update(params, target_params, log_alpha, opt_states, batch, key):
+        k1, k2 = jax.random.split(key)
+        pi_state, q_state, a_state = opt_states
+        obs = batch[0]
+
+        # critics (gradients flow to q1/q2 only)
+        (c_loss, mean_q), c_grads = jax.value_and_grad(
+            critic_loss, has_aux=True)(params, target_params, log_alpha,
+                                       batch, k1)
+        c_grads = {**c_grads, "pi": jax.tree_util.tree_map(
+            jnp.zeros_like, c_grads["pi"])}
+        q_upd, q_state = q_opt.update(c_grads, q_state, params)
+        params = optax.apply_updates(params, q_upd)
+
+        # actor (gradients to pi only, critics frozen)
+        (a_loss_v, logp), pi_grads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["pi"], params, log_alpha,
+                                      obs, k2)
+        pi_upd, pi_state = pi_opt.update(pi_grads, pi_state, params["pi"])
+        params = {**params,
+                  "pi": optax.apply_updates(params["pi"], pi_upd)}
+
+        # temperature
+        al_v, al_grad = jax.value_and_grad(alpha_loss)(log_alpha, logp)
+        al_upd, a_state = a_opt.update(al_grad, a_state, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, al_upd)
+
+        # polyak target update (the reference's tau soft sync)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1.0 - tau) * t + tau * p, target_params, params)
+
+        stats = {"critic_loss": c_loss, "actor_loss": a_loss_v,
+                 "alpha_loss": al_v, "alpha": jnp.exp(log_alpha),
+                 "mean_q": mean_q, "entropy": -logp.mean()}
+        return (params, target_params, log_alpha,
+                (pi_state, q_state, a_state), stats)
+
+    return update
+
+
+class SACRolloutWorker:
+    """Stochastic-policy transition collector for continuous actions:
+    samples from the squashed Gaussian (exploration IS the policy noise);
+    the first ``random_steps`` draw uniform actions to seed the replay
+    (the reference's initial random exploration)."""
+
+    def __init__(self, env_spec, env_config: Optional[dict], hidden,
+                 seed: int):
+        import jax
+
+        from .. import _worker_context
+
+        if _worker_context.in_worker():
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        self.env = make_env(env_spec, env_config)
+        self.bound = float(getattr(self.env, "action_bound", 1.0))
+        self.act_dim = int(getattr(self.env, "action_dim", 1))
+        self.rng = np.random.default_rng(seed)
+        self.key = jax.random.PRNGKey(seed)
+        self.params = sac_init(jax.random.key(0), self.env.observation_dim,
+                               self.act_dim, hidden)
+        self._obs = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self.episode_rewards: List[float] = []
+        self.episode_lengths: List[int] = []
+        self._steps_done = 0
+
+    def ready(self) -> str:
+        return "ok"
+
+    def set_weights(self, weights) -> None:
+        # the learner broadcasts only the pi subtree (all a rollout
+        # worker ever evaluates); merge it over the local placeholder
+        self.params = {**self.params,
+                       "pi": params_from_numpy(weights["pi"])}
+
+    def sample(self, num_steps: int,
+               random_steps: int = 0) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        D, A = self.env.observation_dim, self.act_dim
+        obs_buf = np.zeros((num_steps, D), np.float32)
+        next_buf = np.zeros((num_steps, D), np.float32)
+        act_buf = np.zeros((num_steps, A), np.float32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+        for t in range(num_steps):
+            if self._steps_done < random_steps:
+                a = self.rng.uniform(-self.bound, self.bound, A)
+            else:
+                self.key, sub = jax.random.split(self.key)
+                a, _ = pi_sample(self.params,
+                                 jnp.asarray(self._obs[None, :]), sub,
+                                 self.bound)
+                a = np.asarray(a)[0]
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            obs_buf[t] = self._obs
+            act_buf[t] = a
+            rew_buf[t] = reward
+            # truncation is not terminal: the TD target still bootstraps
+            done_buf[t] = float(terminated)
+            next_buf[t] = next_obs
+            self._episode_reward += reward
+            self._episode_len += 1
+            self._steps_done += 1
+            if terminated or truncated:
+                self.episode_rewards.append(self._episode_reward)
+                self.episode_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                next_obs = self.env.reset(
+                    seed=int(self.rng.integers(1 << 31)))
+            self._obs = next_obs
+        return {
+            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
+            NEXT_OBS: next_buf, sb.DONES: done_buf,
+        }
+
+    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
+        rewards = self.episode_rewards[-window:]
+        lengths = self.episode_lengths[-window:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else None,
+            "episode_len_mean": float(np.mean(lengths)) if lengths
+            else None,
+        }
+
+
+class _SACWorkerSet(WorkerSet):
+    def __init__(self, env_spec, env_config, hidden, num_workers: int,
+                 seed: int):
+        cls = api.remote(SACRolloutWorker)
+        self.remote_workers = [
+            cls.options(num_cpus=1).remote(
+                env_spec, env_config, hidden, seed + 1000 * (i + 1))
+            for i in range(num_workers)
+        ]
+        api.get([w.ready.remote() for w in self.remote_workers])
+
+    def sample(self, num_steps: int, random_steps: int = 0) -> List:
+        return [w.sample.remote(num_steps, random_steps)
+                for w in self.remote_workers]
+
+
+class SAC(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = config
+        seed = config.get("seed", 0)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        self.obs_dim = probe_env.observation_dim
+        self.act_dim = int(getattr(probe_env, "action_dim", 1))
+        self.bound = float(getattr(probe_env, "action_bound", 1.0))
+        hidden = config.get("hidden", (64, 64))
+        self.params = sac_init(jax.random.key(seed), self.obs_dim,
+                               self.act_dim, hidden)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.log_alpha = jnp.asarray(
+            float(np.log(config.get("initial_alpha", 1.0))))
+        self.gamma = config.get("gamma", 0.99)
+        self.tau = config.get("tau", 0.005)
+        # the standard heuristic target: -|A|
+        self.target_entropy = config.get(
+            "target_entropy", -float(self.act_dim))
+        lr = config.get("lr", 3e-4)
+        self._pi_opt = optax.adam(config.get("actor_lr", lr))
+        self._q_opt = optax.adam(config.get("critic_lr", lr))
+        self._a_opt = optax.adam(config.get("alpha_lr", lr))
+        self.opt_states = (self._pi_opt.init(self.params["pi"]),
+                           self._q_opt.init(self.params),
+                           self._a_opt.init(self.log_alpha))
+        self._update = make_sac_update(
+            self._pi_opt, self._q_opt, self._a_opt, self.gamma, self.tau,
+            self.target_entropy, self.bound)
+        self.replay = ReplayBuffer(
+            config.get("replay_buffer_capacity", 100_000), seed=seed)
+        self.learning_starts = config.get("learning_starts", 500)
+        self.random_steps = config.get("random_steps", 500)
+        self.train_batch_size = config.get("train_batch_size", 128)
+        self.updates_per_step = config.get("updates_per_step", 32)
+        self._key = jax.random.PRNGKey(seed + 7)
+        self._updates_done = 0
+        self._timesteps_total = 0
+
+        n_workers = config.get("num_rollout_workers", 0)
+        self.workers = None
+        self.local_worker = None
+        if n_workers > 0:
+            self.workers = _SACWorkerSet(
+                config["env_spec"], config.get("env_config"), hidden,
+                n_workers, seed)
+        else:
+            self.local_worker = SACRolloutWorker(
+                config["env_spec"], config.get("env_config"), hidden, seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        fragment = self.cfg.get("rollout_fragment_length", 64)
+        self._sync_weights()
+        if self.workers is not None:
+            batches = api.get(
+                self.workers.sample(fragment, self.random_steps))
+        else:
+            batches = [self.local_worker.sample(
+                fragment, self.random_steps)]
+        n = 0
+        for b in batches:
+            self.replay.add_batch(b)
+            n += len(b[sb.ACTIONS])
+        self._timesteps_total += n
+        sample_time = time.time() - t0
+
+        stats: Dict[str, Any] = {}
+        t1 = time.time()
+        if len(self.replay) >= self.learning_starts:
+            for _ in range(self.updates_per_step):
+                mb = self.replay.sample(self.train_batch_size)
+                self._key, sub = jax.random.split(self._key)
+                batch = (jnp.asarray(mb[sb.OBS]),
+                         jnp.asarray(mb[sb.ACTIONS]),
+                         jnp.asarray(mb[sb.REWARDS]),
+                         jnp.asarray(mb[NEXT_OBS]),
+                         jnp.asarray(mb[sb.DONES]))
+                (self.params, self.target_params, self.log_alpha,
+                 self.opt_states, stats) = self._update(
+                    self.params, self.target_params, self.log_alpha,
+                    self.opt_states, batch, sub)
+                self._updates_done += 1
+        learn_time = time.time() - t1
+
+        out = {k: float(v) for k, v in stats.items()}
+        out.update({
+            "num_env_steps_sampled": n,
+            "replay_size": len(self.replay),
+            "num_updates": self._updates_done,
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+        })
+        return out
+
+    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic (mean) action for evaluation."""
+        import jax.numpy as jnp
+
+        out = mlp_apply(self.params["pi"], jnp.asarray(obs[None, :]))
+        mu = np.asarray(out)[0, : self.act_dim]
+        return self.bound * np.tanh(mu)
+
+    def _sync_weights(self) -> None:
+        """Rollout workers only run the policy — ship just the pi subtree
+        (a third of the full twin-Q tree) per broadcast."""
+        weights = {"pi": params_to_numpy(self.params["pi"])}
+        if self.workers is not None:
+            self.workers.set_weights(weights)
+        else:
+            self.local_worker.set_weights(weights)
+
+    def _save_extra_state(self):
+        return {
+            "target_params": params_to_numpy(self.target_params),
+            "opt_states": params_to_numpy(self.opt_states),
+            "log_alpha": float(self.log_alpha),
+            "key": params_to_numpy(self._key),
+            "updates_done": self._updates_done,
+        }
+
+    def _load_extra_state(self, state) -> None:
+        import jax.numpy as jnp
+
+        if not state:
+            return
+        if "target_params" in state:
+            self.target_params = params_from_numpy(state["target_params"])
+        if "opt_states" in state:
+            # Adam moments restore too — resetting them on restore is an
+            # effective learning-rate spike mid-run
+            self.opt_states = params_from_numpy(state["opt_states"])
+        if "log_alpha" in state:
+            self.log_alpha = jnp.asarray(state["log_alpha"])
+        if "key" in state:
+            self._key = jnp.asarray(state["key"])
+        self._updates_done = state.get("updates_done", 0)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self.extra.update({
+            "replay_buffer_capacity": 100_000, "learning_starts": 500,
+            "random_steps": 500, "updates_per_step": 32, "tau": 0.005,
+            "initial_alpha": 1.0,
+        })
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 random_steps=None, updates_per_step=None, tau=None,
+                 target_entropy=None, actor_lr=None, critic_lr=None,
+                 alpha_lr=None, initial_alpha=None, **kwargs) -> "SACConfig":
+        super().training(**kwargs)
+        for k, v in (
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("learning_starts", learning_starts),
+                ("random_steps", random_steps),
+                ("updates_per_step", updates_per_step),
+                ("tau", tau), ("target_entropy", target_entropy),
+                ("actor_lr", actor_lr), ("critic_lr", critic_lr),
+                ("alpha_lr", alpha_lr), ("initial_alpha", initial_alpha)):
+            if v is not None:
+                self.extra[k] = v
+        return self

@@ -285,3 +285,83 @@ class TestDQN:
         assert a in (0, 1)
         assert algo2._updates_done == r["num_updates"]
         algo2.stop()
+
+
+class TestSAC:
+    def test_learns_pendulum(self):
+        """Continuous-control learning regression: twin-Q SAC with
+        entropy auto-tuning improves pendulum swing-up well past the
+        random-policy plateau (~-1200..-1400 per 200-step episode; the
+        reference's tuned_examples/sac/pendulum-sac.yaml contract,
+        CI-scaled)."""
+        from ray_memory_management_tpu.rllib import SACConfig
+
+        algo = (SACConfig()
+                .environment("Pendulum",
+                             env_config={"max_episode_steps": 200})
+                .rollouts(num_rollout_workers=0,
+                          rollout_fragment_length=200)
+                .training(lr=1e-3, train_batch_size=128,
+                          learning_starts=500, random_steps=500,
+                          updates_per_step=200, tau=0.005)
+                .debugging(seed=1)
+                .build())
+        result = {}
+        for _ in range(80):
+            result = algo.train()
+            rm = result.get("episode_reward_mean")
+            if rm is not None and rm > -700:
+                break
+        assert result["episode_reward_mean"] > -900, result
+        assert result["num_updates"] > 1000
+        # entropy auto-tuning drove alpha off its 1.0 init
+        assert 0 < result["alpha"] < 0.9
+        # the deterministic (mean) policy emits in-range actions
+        import numpy as np
+
+        a = algo.compute_single_action(
+            np.array([1.0, 0.0, 0.0], np.float32))
+        assert a.shape == (1,) and abs(float(a[0])) <= 2.0
+        algo.stop()
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        """save/restore preserves learner state (target nets, temperature,
+        optimizer progress) — the Trainable save/restore contract."""
+        from ray_memory_management_tpu.rllib import SACConfig
+
+        def build():
+            return (SACConfig()
+                    .environment("Pendulum",
+                                 env_config={"max_episode_steps": 50})
+                    .rollouts(num_rollout_workers=0,
+                              rollout_fragment_length=64)
+                    .training(train_batch_size=32, learning_starts=64,
+                              random_steps=64, updates_per_step=4)
+                    .debugging(seed=3)
+                    .build())
+
+        import jax
+        import numpy as np
+
+        algo = build()
+        for _ in range(3):
+            algo.train()
+        blob = algo.save()
+        updates = algo._updates_done
+        alpha = float(algo.log_alpha)
+        moments = [np.asarray(leaf).sum()
+                   for leaf in jax.tree_util.tree_leaves(algo.opt_states)]
+        algo.stop()
+
+        algo2 = build()
+        algo2.restore(blob)
+        assert algo2._updates_done == updates
+        assert abs(float(algo2.log_alpha) - alpha) < 1e-6
+        # Adam moments really restored (not re-init'd to zeros)
+        moments2 = [np.asarray(leaf).sum()
+                    for leaf in jax.tree_util.tree_leaves(algo2.opt_states)]
+        assert len(moments2) == len(moments)
+        np.testing.assert_allclose(moments2, moments, rtol=1e-6)
+        algo2.train()  # must keep training from the restored state
+        assert algo2._updates_done > updates
+        algo2.stop()

@@ -1,0 +1,218 @@
+"""Runtime stress tests: metadata GC under churn and multithreaded chaos.
+
+The Python tier of the race-detection story (the C++ store runs under
+TSAN/ASAN in tests/test_native_stress.py; the reference sanitizes its
+whole C++ runtime, .bazelrc:92-106): the driver runtime is dozens of
+cooperating threads (router, sender pool, request pool, heartbeat,
+accept), so these tests drive it concurrently from many client threads
+and assert the invariants that racing would break — no deadlock, no lost
+object, no negative refcount, bounded task metadata.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_memory_management_tpu as rmt
+
+
+def test_task_metadata_bounded_under_churn():
+    """Distributed task-metadata GC at volume: across 50k task
+    completions the runtime's task table must stay bounded (records prune
+    once their returns are consumed and lineage no longer pins them —
+    runtime._try_prune_record_locked); an unbounded table is exactly the
+    head-memory leak the reference's _peak_memory tracking guards
+    against."""
+    rt = rmt.init(num_cpus=4)
+    try:
+        @rmt.remote(max_retries=0)
+        def tiny(i):
+            return i
+
+        peak_tasks = 0
+        peak_futures = 0
+        total = 50_000
+        batch = 2_000
+        for start in range(0, total, batch):
+            refs = [tiny.remote(i) for i in range(start, start + batch)]
+            out = rmt.get(refs, timeout=300)
+            assert out[0] == start and out[-1] == start + batch - 1
+            del refs, out
+            peak_tasks = max(peak_tasks, len(rt.tasks))
+            peak_futures = max(peak_futures, len(rt.futures))
+        # bound: a few in-flight batches worth, NOT O(total). The exact
+        # constant is generous — the failure mode this guards against is
+        # linear growth to ~50k entries.
+        assert peak_tasks < 3 * batch, peak_tasks
+        assert peak_futures < 3 * batch, peak_futures
+    finally:
+        rmt.shutdown()
+
+
+class _Chaos:
+    """Shared state for the chaos threads: first failure wins."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self.errors = []
+        self.mu = threading.Lock()
+        self.ops = 0
+
+    def fail(self, err: str) -> None:
+        with self.mu:
+            self.errors.append(err)
+        self.stop.set()
+
+    def tick(self) -> None:
+        with self.mu:
+            self.ops += 1
+
+
+def test_multithreaded_driver_chaos():
+    """8+ driver threads run submit/get/put/free/actor-kill/node-churn
+    concurrently for 60s: every get must return the right value (no lost
+    objects), the run must not deadlock (bounded wall time enforced by
+    joins), and at the end no refcount may be negative and task metadata
+    must have pruned."""
+    duration_s = float(os.environ.get("RMT_CHAOS_SECONDS", "60"))
+    rt = rmt.init(num_cpus=4, num_nodes=2)
+    chaos = _Chaos()
+    try:
+        @rmt.remote(max_retries=2)
+        def add(a, b):
+            return a + b
+
+        @rmt.remote(max_retries=2)
+        def big(i):
+            return np.full(100_000, i, np.int64)  # 800KB: store object
+
+        @rmt.remote(num_cpus=0, max_restarts=0)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        def tasks_loop(seed):
+            rng = np.random.default_rng(seed)
+            while not chaos.stop.is_set():
+                try:
+                    n = int(rng.integers(4, 16))
+                    vals = [int(rng.integers(0, 1000)) for _ in range(n)]
+                    refs = [add.remote(v, seed) for v in vals]
+                    out = rmt.get(refs, timeout=120)
+                    if out != [v + seed for v in vals]:
+                        chaos.fail(f"wrong task results: {out[:4]}...")
+                    chaos.tick()
+                except Exception as e:  # noqa: BLE001
+                    chaos.fail(f"tasks_loop: {e!r}")
+
+        def objects_loop(seed):
+            rng = np.random.default_rng(seed)
+            while not chaos.stop.is_set():
+                try:
+                    i = int(rng.integers(0, 100))
+                    ref = big.remote(i)
+                    if rng.random() < 0.3:
+                        del ref  # free a possibly-unfinished task's return
+                        chaos.tick()
+                        continue
+                    arr = rmt.get(ref, timeout=120)
+                    if arr[0] != i or arr.shape != (100_000,):
+                        chaos.fail(f"lost/corrupt object: {arr[:2]}")
+                    del ref, arr
+                    chaos.tick()
+                except Exception as e:  # noqa: BLE001
+                    chaos.fail(f"objects_loop: {e!r}")
+
+        def put_loop(seed):
+            rng = np.random.default_rng(seed)
+            while not chaos.stop.is_set():
+                try:
+                    v = int(rng.integers(0, 1 << 30))
+                    ref = rmt.put((v, bytes(int(rng.integers(1, 2000)))))
+                    got = rmt.get(ref, timeout=60)
+                    if got[0] != v:
+                        chaos.fail(f"put/get mismatch: {got[0]} != {v}")
+                    del ref
+                    chaos.tick()
+                except Exception as e:  # noqa: BLE001
+                    chaos.fail(f"put_loop: {e!r}")
+
+        def actor_loop(seed):
+            from ray_memory_management_tpu.exceptions import ActorDiedError
+
+            rng = np.random.default_rng(seed)
+            while not chaos.stop.is_set():
+                try:
+                    c = Counter.remote()
+                    k = int(rng.integers(1, 4))
+                    out = rmt.get([c.inc.remote() for _ in range(k)],
+                                  timeout=120)
+                    if out != list(range(1, k + 1)):
+                        chaos.fail(f"actor ordering broke: {out}")
+                    rmt.kill(c)
+                    chaos.tick()
+                except ActorDiedError:
+                    # legitimate: the churn thread removed the node this
+                    # max_restarts=0 actor landed on — the invariant under
+                    # test is "correct results or a clean death error",
+                    # never a hang or a wrong answer
+                    chaos.tick()
+                except Exception as e:  # noqa: BLE001
+                    chaos.fail(f"actor_loop: {e!r}")
+
+        def node_churn_loop():
+            while not chaos.stop.is_set():
+                nid = None
+                try:
+                    time.sleep(3.0)
+                    nid = rt.add_node({"num_cpus": 2})
+                    time.sleep(3.0)
+                    chaos.tick()
+                except Exception as e:  # noqa: BLE001
+                    chaos.fail(f"node_churn add: {e!r}")
+                finally:
+                    if nid is not None:
+                        try:
+                            rt.remove_node(nid)
+                        except Exception as e:  # noqa: BLE001
+                            chaos.fail(f"node_churn remove: {e!r}")
+
+        threads = (
+            [threading.Thread(target=tasks_loop, args=(s,), daemon=True)
+             for s in range(3)]
+            + [threading.Thread(target=objects_loop, args=(10 + s,),
+                                daemon=True) for s in range(2)]
+            + [threading.Thread(target=put_loop, args=(20,), daemon=True)]
+            + [threading.Thread(target=actor_loop, args=(30,), daemon=True),
+               threading.Thread(target=actor_loop, args=(31,), daemon=True)]
+            + [threading.Thread(target=node_churn_loop, daemon=True)]
+        )
+        for t in threads:
+            t.start()
+        chaos.stop.wait(duration_s)
+        chaos.stop.set()
+        deadline = time.monotonic() + 180
+        for t in threads:
+            t.join(max(1.0, deadline - time.monotonic()))
+        stuck = [t.name for t in threads if t.is_alive()]
+        assert not stuck, f"threads wedged (deadlock?): {stuck}"
+        assert not chaos.errors, chaos.errors[:3]
+        assert chaos.ops > 50, f"chaos barely ran: {chaos.ops} ops"
+
+        # invariant sweep after the storm
+        with rt._lock:
+            negative = {k.hex()[:8]: v for k, v in rt.local_refs.items()
+                        if v < 0}
+        assert not negative, f"negative refcounts: {negative}"
+        # task table pruned back to O(in-flight), not O(everything ever)
+        time.sleep(1.0)
+        assert len(rt.tasks) < 5_000, len(rt.tasks)
+    finally:
+        rmt.shutdown()
