@@ -115,6 +115,7 @@ class NodeAgent:
         from .transfer import TransferServer, fetch_object as _fetch_object
 
         self._fetch_object = _fetch_object
+        self._shm_peers: Dict[str, Any] = {}  # same-host peer store maps
         self.transfer_server = TransferServer(
             self.store, authkey, self.config.object_manager_chunk_size)
         self._fetch_pool = ThreadPoolExecutor(
@@ -123,6 +124,10 @@ class NodeAgent:
             "type": "transfer_ready",
             "host": self._my_ip,
             "port": self.transfer_server.port,
+            # same-host peers (other agents, the head) map this shm store
+            # directly instead of pulling over TCP — the named segment IS
+            # the shared-memory object plane on one host
+            "store_name": self.store_name,
         })
 
         # permission-trusted worker socket, like the head's (0600 file;
@@ -391,25 +396,62 @@ class NodeAgent:
 
     def _obj_fetch(self, msg: dict) -> None:
         """Pull an object DIRECTLY from a peer's transfer server into this
-        store (receiver-driven transfer; host "" = the head). Runs on the
-        fetch pool so a slow source never blocks the object plane or the
-        channel loop."""
+        store (receiver-driven transfer; host "" = the head). When the
+        head marked the source as same-host ("src_store"), map the
+        source's shm segment and memcpy — no TCP, no chunk protocol —
+        falling back to the server pull if the object isn't shm-resident
+        there (spilled) or the mapping fails. Runs on the fetch pool so a
+        slow source never blocks the object plane or the channel loop."""
         host = msg["host"] or self._head_ip
         port, oid, req = msg["port"], msg["oid"], msg["req"]
+        src_store = msg.get("src_store")
 
         def run():
-            try:
-                err = self._fetch_object(
-                    host, port, self._cluster_authkey, oid, self.store,
-                    self.config.object_manager_chunk_size)
-            except Exception as e:  # noqa: BLE001
-                err = repr(e)
+            err = None
+            if src_store:
+                err = self._fetch_same_host(src_store, oid)
+            if src_store is None or err is not None:
+                try:
+                    err = self._fetch_object(
+                        host, port, self._cluster_authkey, oid, self.store,
+                        self.config.object_manager_chunk_size)
+                except Exception as e:  # noqa: BLE001
+                    err = repr(e)
             try:
                 self._send({"type": "fetch_ack", "req": req, "error": err})
             except (OSError, BrokenPipeError):
                 pass
 
         self._fetch_pool.submit(run)
+
+    def _fetch_same_host(self, store_name: str, oid: bytes) -> Optional[str]:
+        """shm-to-shm copy from a same-host peer's segment. Returns None
+        on success, else a reason string (caller falls back to the TCP
+        pull — e.g. the object is spilled inside the source process,
+        invisible through its segment)."""
+        try:
+            cli = self._shm_peers.get(store_name)
+            if cli is None:
+                from .object_store import StoreClient
+
+                cli = StoreClient(store_name)
+                self._shm_peers[store_name] = cli
+            view = cli.get(oid)  # shared-segment reader ref (plasma-style)
+            if view is None:
+                return "not shm-resident at source"
+            try:
+                try:
+                    buf = self.store.create(oid, view.nbytes)
+                except ValueError:
+                    return None  # already present here
+                buf[:] = view
+                del buf
+                self.store.seal(oid)
+                return None
+            finally:
+                cli.release(oid)
+        except Exception as e:  # noqa: BLE001
+            return repr(e)
 
     def _obj_spill(self, msg: dict) -> None:
         """Head-requested spill: a worker's direct shm put needs room (the

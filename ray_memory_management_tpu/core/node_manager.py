@@ -36,7 +36,7 @@ class WorkerHandle:
                  "known_fns", "known_classes", "actor_id", "inflight",
                  "lease_resources", "visible_chips", "pending_msgs",
                  "death_processed", "send_lock", "steal_pending",
-                 "_alive_checked_at")
+                 "re_inflight", "_alive_checked_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -55,6 +55,7 @@ class WorkerHandle:
         self.known_classes: Set[bytes] = set()
         self.actor_id: Optional[bytes] = None  # dedicated actor worker
         self.inflight: Dict[bytes, TaskSpec] = {}  # task_id -> spec
+        self.re_inflight = 0  # inflight tasks carrying a runtime_env
         self.lease_resources: Optional[Resources] = None
         self.visible_chips: Optional[List[int]] = None
         self.pending_msgs: List[dict] = []  # queued until registration
@@ -352,6 +353,8 @@ class NodeManager:
                 self.queue.popleft()
                 handle.idle = False
                 handle.inflight[spec.task_id] = spec
+                if spec.runtime_env:
+                    handle.re_inflight += 1
                 if lease:
                     self.resources.allocate(req)
                     handle.lease_resources = req
@@ -408,6 +411,8 @@ class NodeManager:
                 spec = handle.inflight.pop(tid, None)
                 if spec is not None:
                     specs.append(spec)
+                    if spec.runtime_env:
+                        handle.re_inflight -= 1
                     # the blob-carrying dispatch may itself be stolen, so
                     # this worker can no longer be assumed to know the fn
                     handle.known_fns.discard(spec.fn_id)
@@ -474,8 +479,7 @@ class NodeManager:
                     and cand.lease_resources == req
                     and cand.ready and cand.alive()
                     and not cand.steal_pending
-                    and not any(s.runtime_env
-                                for s in cand.inflight.values())):
+                    and cand.re_inflight == 0):
                 best = cand
                 best_depth = len(cand.inflight)
         return best
@@ -484,7 +488,9 @@ class NodeManager:
         """Release the task; free the lease and return the worker to the
         pool once its pipeline drains."""
         with self._lock:
-            handle.inflight.pop(task_id, None)
+            spec = handle.inflight.pop(task_id, None)
+            if spec is not None and spec.runtime_env:
+                handle.re_inflight -= 1
             if handle.inflight:
                 return  # pipelined tasks still riding this lease
             if handle.lease_resources is not None:

@@ -160,6 +160,9 @@ class RemoteNodeManager(NodeManager):
         # (host, port) of the agent's TransferServer, set by its
         # transfer_ready frame; None until then (fallback: channel push)
         self.transfer_addr: Optional[tuple] = None
+        # the agent's shm store name (same transfer_ready frame): when the
+        # agent shares this host, its store can be mapped directly
+        self.remote_store_name: Optional[str] = None
         self._channel_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, dict] = {}       # req -> accumulating state
@@ -300,18 +303,24 @@ class RemoteNodeManager(NodeManager):
         return {oid: oid not in failed for oid in object_ids}
 
     def fetch_from_peer(self, oid: bytes, host: str, port: int,
-                        timeout: float = 120.0) -> Optional[str]:
+                        timeout: float = 120.0,
+                        src_store: Optional[str] = None) -> Optional[str]:
         """Tell the agent to pull ``oid`` straight from a peer's transfer
-        server (host "" = the head). Returns None on success, else an error
-        string. Payload bytes never touch the head or this channel."""
+        server (host "" = the head). ``src_store`` names the source's shm
+        segment when the peer shares the agent's host — the agent then
+        maps it and memcpys instead of speaking TCP. Returns None on
+        success, else an error string. Payload bytes never touch the head
+        or this channel."""
         if not self.alive:
             return "node dead"
         req = self._new_req()
+        msg = {"type": "obj_fetch", "oid": oid, "host": host,
+               "port": port, "req": req}
+        if src_store:
+            msg["src_store"] = src_store
         with self._pending_lock:
             state = self._pending.get(req)
-        if state is None or not self.channel_send(
-                {"type": "obj_fetch", "oid": oid, "host": host,
-                 "port": port, "req": req}):
+        if state is None or not self.channel_send(msg):
             with self._pending_lock:
                 self._pending.pop(req, None)
             return "channel send failed"

@@ -155,6 +155,98 @@ class _SenderPool:
                     break
 
 
+class _SlimFuture:
+    """Minimal future for object resolution (the values in
+    ``runtime.futures``). One is allocated per task return on the submit
+    hot path, where ``concurrent.futures.Future``'s per-instance lock +
+    condition cost ~9us each — this one allocates three slots and shares
+    a single class-level condition across all instances (completions far
+    outnumber waiters, and a waiter re-checking its own future on a
+    broadcast costs microseconds). API-compatible with the stdlib Future
+    for the operations the runtime uses: done / result / set_result /
+    set_exception / add_done_callback."""
+
+    __slots__ = ("_state", "_value", "_cbs")
+
+    _cond = threading.Condition()
+    _PENDING, _RESULT, _EXC = 0, 1, 2
+
+    def __init__(self):
+        self._state = 0
+        self._value = None
+        self._cbs = None
+
+    def done(self) -> bool:
+        return self._state != 0
+
+    def _finish(self, state: int, value) -> None:
+        with self._cond:
+            if self._state:
+                return  # first completion wins, like the stdlib
+            self._value = value
+            self._state = state
+            cbs, self._cbs = self._cbs, None
+            self._cond.notify_all()
+        for cb in cbs or ():
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — parity with stdlib
+                pass
+
+    def set_result(self, value) -> None:
+        self._finish(self._RESULT, value)
+
+    def set_exception(self, exc) -> None:
+        self._finish(self._EXC, exc)
+
+    def set_result_quiet(self, value) -> None:
+        """Resolve without waking waiters — for burst completion paths
+        that call :meth:`broadcast` ONCE after resolving a whole batch
+        (per-future notify_all made a parked getter context-switch per
+        completion instead of per batch). Callbacks still fire here."""
+        with self._cond:
+            if self._state:
+                return
+            self._value = value
+            self._state = self._RESULT
+            cbs, self._cbs = self._cbs, None
+        for cb in cbs or ():
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    @classmethod
+    def broadcast(cls) -> None:
+        with cls._cond:
+            cls._cond.notify_all()
+
+    def add_done_callback(self, cb) -> None:
+        with self._cond:
+            if not self._state:
+                if self._cbs is None:
+                    self._cbs = []
+                self._cbs.append(cb)
+                return
+        cb(self)
+
+    def result(self, timeout: Optional[float] = None):
+        # fast path: no lock when already resolved (reads are safe: _state
+        # is written last under the condition, and the GIL orders it)
+        state = self._state
+        if not state:
+            with self._cond:
+                self._cond.wait_for(lambda: self._state, timeout)
+                state = self._state
+        if state == self._RESULT:
+            return self._value
+        if state == self._EXC:
+            raise self._value
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        raise _FutTimeout()
+
+
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "state", "payload",
                  "args_released", "gc_returns")
@@ -253,8 +345,12 @@ class Runtime:
             max_workers=8, thread_name_prefix="rmt-serve"
         )
         self._transfer_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="rmt-xfer"
+            max_workers=8, thread_name_prefix="rmt-xfer"
         )
+        self._xfer_serving: Dict[NodeID, int] = {}  # outbound serves/node
+        import socket as _socket
+
+        self._hostname = _socket.gethostname()  # fixed for process life
         self._conn_send_locks: Dict[Any, threading.Lock] = {}
         # lazy p2p transfer servers over LOCAL node stores (node_id -> srv)
         self._xfer_servers: Dict[NodeID, Any] = {}
@@ -396,10 +492,22 @@ class Runtime:
         nm = self.nodes.get(node_id)
         return nm.backlog() if nm is not None else 0
 
+    def _same_host_store(self, nm) -> Optional[str]:
+        """The shm store name of ``nm`` if its store lives on THIS host
+        (an agent that registered from the same hostname advertises its
+        segment name in transfer_ready), else None. Same-host reads map
+        the segment directly — one kernel, zero protocol."""
+        name = getattr(nm, "remote_store_name", None)
+        if name and getattr(nm, "hostname", None) == self._hostname:
+            return name
+        return None
+
     def _store_client_for(self, node_id: NodeID) -> StoreClient:
-        # Same-host nodes: the driver maps the store directly (one kernel).
-        # Remote nodes: reads ride the chunked DCN object plane through the
-        # node's agent channel (object_manager.proto:63-67 analog).
+        # Same-host nodes: the driver maps the store directly (one kernel)
+        # — including same-host AGENTS, whose store is just another named
+        # shm segment. True remote nodes: reads ride the chunked DCN
+        # object plane through the node's agent channel
+        # (object_manager.proto:63-67 analog).
         with self._lock:
             cli = self._store_clients.get(node_id)
             if cli is None:
@@ -407,7 +515,14 @@ class Runtime:
                 from .remote_node import RemoteNodeManager
 
                 if isinstance(nm, RemoteNodeManager):
-                    cli = nm.store  # RemoteStoreProxy
+                    shm_name = self._same_host_store(nm)
+                    if shm_name is not None:
+                        try:
+                            cli = StoreClient(shm_name)
+                        except Exception:  # noqa: BLE001 — segment gone:
+                            cli = nm.store  # fall back to the channel
+                    else:
+                        cli = nm.store  # RemoteStoreProxy
                 elif nm is self.head_node():
                     # reuse the node's own mapping
                     cli = nm.store
@@ -545,8 +660,10 @@ class Runtime:
             nm.on_channel_reply(msg)
         elif mtype == "transfer_ready":
             # the agent's p2p transfer server is up: record where peers
-            # (and the head) can pull this node's objects from
+            # (and the head) can pull this node's objects from — and its
+            # shm store name, which same-host peers map directly
             nm.transfer_addr = (msg["host"], msg["port"])
+            nm.remote_store_name = msg.get("store_name")
         elif mtype == "wdeath":
             handle = nm.worker_by_wid(msg["wid"])
             if handle is not None:
@@ -907,7 +1024,7 @@ class Runtime:
         with self._lock:
             self.tasks[spec.task_id] = rec
             for oid in return_ids:
-                self.futures[oid] = Future()
+                self.futures[oid] = _SlimFuture()
                 self.lineage[oid] = spec.task_id
                 if adopt_returns:
                     # pre-registered handle ref, ADOPTED by the caller's
@@ -925,11 +1042,7 @@ class Runtime:
         return return_ids
 
     def _ref_deps(self, spec: TaskSpec) -> List[bytes]:
-        deps = []
-        for kind, payload in list(spec.args) + list(spec.kwargs.values()):
-            if kind == "ref":
-                deps.append(payload)
-        return deps
+        return spec.ref_deps  # cached on the spec (see TaskSpec.ref_deps)
 
     def _queue_when_deps_ready_locked(self, spec: TaskSpec) -> bool:
         """With self._lock held: either park the task on its unresolved
@@ -1082,6 +1195,11 @@ class Runtime:
                 locs = [l for l in locs if l != node_id and
                         self.nodes.get(l) and self.nodes[l].alive]
                 if not locs:
+                    # abandoning this scan: roll back the serve counts
+                    # already taken for earlier deps, or source selection
+                    # would permanently shun those nodes
+                    for _, src in to_fetch:
+                        self._xfer_dec_locked(src)
                     if oid in self._device_locations:
                         # device-resident dep: materialize off the router
                         # thread, then re-place the task
@@ -1094,21 +1212,48 @@ class Runtime:
                         self._recover_then_reschedule, oid, spec, node_id
                     )
                     return False
-                to_fetch.append((oid, locs[0]))
+                # any holder can serve: pick the location with the fewest
+                # in-flight outbound serves, so a broadcast fans out over
+                # every node that already received a copy instead of
+                # serializing on the original producer (the reference's
+                # object manager likewise pulls from any holder,
+                # object_manager.h:114)
+                src = min(locs,
+                          key=lambda l: self._xfer_serving.get(l, 0))
+                self._xfer_serving[src] = \
+                    self._xfer_serving.get(src, 0) + 1
+                to_fetch.append((oid, src))
         if not to_fetch:
             return True
 
         def do_transfers():
+            done = 0
             try:
                 for oid, src in to_fetch:
-                    self._transfer_object(oid, src, node_id)
+                    try:
+                        self._transfer_object(oid, src, node_id)
+                    finally:
+                        done += 1
+                        with self._lock:
+                            self._xfer_dec_locked(src)
                 self.nodes[node_id].submit(spec)
                 self._wakeup()
             except Exception as e:  # transfer failed: fail the task
+                # release the counts of the never-attempted remainder
+                with self._lock:
+                    for _, src in to_fetch[done:]:
+                        self._xfer_dec_locked(src)
                 self._fail_task(spec, TaskError(spec.name, e))
 
         self._transfer_pool.submit(do_transfers)
         return False
+
+    def _xfer_dec_locked(self, src: NodeID) -> None:
+        n = self._xfer_serving.get(src, 1) - 1
+        if n > 0:
+            self._xfer_serving[src] = n
+        else:
+            self._xfer_serving.pop(src, None)
 
     def _local_transfer_server(self, node_id: NodeID):
         """Lazy TransferServer over a LOCAL node's store, so remote agents
@@ -1141,13 +1286,20 @@ class Runtime:
         dst_remote = isinstance(dst_nm, RemoteNodeManager)
 
         if dst_remote:
-            # destination agent pulls from the source's server
+            # destination agent pulls from the source's server; when the
+            # two share a host it maps the source's shm segment directly
+            # and memcpys (no TCP, no chunk protocol)
             if src_remote:
                 addr = src_nm.transfer_addr
+                src_store = (src_nm.remote_store_name
+                             if src_nm.hostname == dst_nm.hostname else None)
             else:
                 addr = ("", self._local_transfer_server(src).port)
+                src_store = (src_nm.store_name
+                             if dst_nm.hostname == self._hostname else None)
             if addr is not None:
-                err = dst_nm.fetch_from_peer(oid, addr[0], addr[1])
+                err = dst_nm.fetch_from_peer(oid, addr[0], addr[1],
+                                             src_store=src_store)
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -1178,6 +1330,14 @@ class Runtime:
         # same-host memcpy, or the channel push/pull fallback
         src_cli = self._store_client_for(src)
         view = src_cli.get(oid)  # local: shm view; remote: pulled bytes
+        if view is None and src_cli is not getattr(src_nm, "store", None):
+            # same-host mapping can't see objects SPILLED inside the
+            # source agent; the channel proxy serves them from the spill
+            # file (mirror of the _read_from_stores fallback)
+            proxy = getattr(src_nm, "store", None)
+            if proxy is not None:
+                view = proxy.get(oid)
+                src_cli = proxy
         if view is None:
             raise ObjectLostError(oid.hex(), f"vanished from {src}")
         try:
@@ -1360,9 +1520,12 @@ class Runtime:
                         self.gcs.add_object_location(oid, handle.node_id)
                     fut = self.futures.get(oid)
                     if fut is None:
-                        self.futures[oid] = fut = Future()
+                        self.futures[oid] = fut = _SlimFuture()
                     if not fut.done():
-                        fut.set_result(True)
+                        if isinstance(fut, _SlimFuture):
+                            fut.set_result_quiet(True)  # broadcast below,
+                        else:                           # once per burst
+                            fut.set_result(True)
                     # dep-waiter resolution under the same (batch-wide) lock
                     if self._deps_ready_locked(oid):
                         nudge = True
@@ -1389,8 +1552,8 @@ class Runtime:
                     to_free.extend(
                         roid for roid in spec.return_ids
                         if roid not in self.local_refs)
-        for oid in to_free:
-            self.free_object(oid)
+        _SlimFuture.broadcast()  # wake getters once for the whole burst
+        self.free_objects(to_free)
         if nudge:
             self._wakeup()
 
@@ -1569,7 +1732,7 @@ class Runtime:
         with self._lock:
             self.tasks[spec.task_id] = rec
             for oid in return_ids:
-                self.futures[oid] = Future()
+                self.futures[oid] = _SlimFuture()
                 # lineage here serves record GC, not reconstruction —
                 # _recover_object refuses actor results explicitly
                 self.lineage[oid] = spec.task_id
@@ -1868,7 +2031,7 @@ class Runtime:
         self.device_store.put(oid, value)
         with self._lock:
             self._device_locations[oid] = "driver"
-            fut = Future()
+            fut = _SlimFuture()
             fut.set_result(True)
             self.futures[oid] = fut
         return oid
@@ -1879,7 +2042,7 @@ class Runtime:
         oid = ObjectID.for_put().binary()
         with self._lock:
             self._device_locations[oid] = handle
-            self.futures[oid] = Future()  # resolved by device_put_sealed
+            self.futures[oid] = _SlimFuture()  # resolved by device_put_sealed
         return oid
 
     def seal_device_put(self, oid: bytes) -> None:
@@ -1924,7 +2087,7 @@ class Runtime:
         with self._lock:
             fut = self._materialize_futs.get(oid)
             if fut is None:
-                fut = Future()
+                fut = _SlimFuture()
                 self._materialize_futs[oid] = fut
                 send_needed = True
             else:
@@ -1979,7 +2142,7 @@ class Runtime:
             nm.store.put_serialized(oid, data)
             self.gcs.add_object_location(oid, nm.node_id)
         with self._lock:
-            fut = Future()
+            fut = _SlimFuture()
             fut.set_result(True)
             self.futures[oid] = fut
         return oid
@@ -1992,7 +2155,7 @@ class Runtime:
         nm.store.put_serialized(oid, data)
         self.gcs.add_object_location(oid, nm.node_id)
         with self._lock:
-            fut = Future()
+            fut = _SlimFuture()
             fut.set_result(True)
             self.futures[oid] = fut
         return oid
@@ -2057,12 +2220,16 @@ class Runtime:
         from .remote_node import RemoteNodeManager
 
         locs = self.gcs.get_object_locations(oid)
+        # "local" = readable through a direct shm mapping: head-local
+        # nodes AND same-host agents (their segment is just another named
+        # mapping — reading it is zero-copy, no localization needed)
         local = [l for l in locs
-                 if not isinstance(self.nodes.get(l), RemoteNodeManager)]
+                 if not isinstance(self.nodes.get(l), RemoteNodeManager)
+                 or self._same_host_store(self.nodes[l]) is not None]
         remote = [l for l in locs if l not in set(local)]
-        # remote-only objects: localize into the head store over the p2p
-        # plane first — a driver get used to buffer the WHOLE object in
-        # head RAM (b"".join of pulled chunks); fetching into the store
+        # truly-remote-only objects: localize into the head store over the
+        # p2p plane first — a driver get used to buffer the WHOLE object
+        # in head RAM (b"".join of pulled chunks); fetching into the store
         # keeps it O(chunk), zero-copy on read, spill-managed, and cached
         # for the next get
         for node_id in remote if not local else ():
@@ -2087,6 +2254,14 @@ class Runtime:
                 continue
             cli = self._store_client_for(node_id)
             view = cli.get(oid)
+            if view is None and cli is not getattr(nm, "store", None):
+                # a same-host mapping of an agent's store cannot see
+                # objects SPILLED inside that agent — the channel proxy
+                # can (its read serves the spill file)
+                proxy = getattr(nm, "store", None)
+                if proxy is not None:
+                    view = proxy.get(oid)
+                    cli = proxy
             if view is None:
                 continue
             # the store refcount taken by get() is held until the last
@@ -2115,7 +2290,7 @@ class Runtime:
             for roid in spec.return_ids:
                 fut = self.futures.get(roid)
                 if fut is None or fut.done():
-                    self.futures[roid] = Future()
+                    self.futures[roid] = _SlimFuture()
             rec.state = "RESUBMITTED"
             # re-acquire the arg pins the first completion released: the
             # re-execution (and the completion sweep that follows it)
@@ -2135,9 +2310,30 @@ class Runtime:
         """Event-driven wait: park on the objects' completion futures
         (FIRST_COMPLETED) instead of polling — the 1 ms busy-poll burned a
         core-share and added latency at scale (the reference's WaitManager
-        is likewise callback-driven, wait_manager.h)."""
-        from concurrent.futures import FIRST_COMPLETED
-        from concurrent.futures import wait as futures_wait
+        is likewise callback-driven, wait_manager.h). Handles a mix of
+        _SlimFuture (every completion broadcasts the shared condition) and
+        stdlib Future (placement-group readiness) by parking on the shared
+        condition with a short cap whenever a stdlib future is present."""
+
+        def futures_wait(futs, timeout):
+            """Returns (done, not_done); empty done ONLY after the full
+            timeout elapsed (callers treat that as a timeout)."""
+            futs = set(futs)
+            end = None if timeout is None else time.monotonic() + timeout
+            while True:
+                done = {f for f in futs if f.done()}
+                if done:
+                    return done, futs - done
+                left = None if end is None else end - time.monotonic()
+                if left is not None and left <= 0:
+                    return done, futs
+                # stdlib futures (PG readiness) don't signal the shared
+                # condition — cap the park so they are re-polled
+                if any(not isinstance(f, _SlimFuture) for f in futs):
+                    left = 0.02 if left is None else min(left, 0.02)
+                with _SlimFuture._cond:
+                    _SlimFuture._cond.wait_for(
+                        lambda: any(f.done() for f in futs), left)
 
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[bytes] = []
@@ -2165,8 +2361,7 @@ class Runtime:
                 if untracked:
                     park = 0.05 if remaining is None else min(remaining,
                                                               0.05)
-                done, _ = futures_wait(futs, timeout=park,
-                                       return_when=FIRST_COMPLETED)
+                done, _ = futures_wait(futs, timeout=park)
                 if not done and not untracked:
                     break  # timed out
             else:
@@ -2190,7 +2385,7 @@ class Runtime:
         with self._lock:
             fut = self.futures.get(ref.binary())
             if fut is None:
-                fut = Future()
+                fut = _SlimFuture()
                 if ref.binary() in self.memory_store or \
                         self.gcs.get_object_locations(ref.binary()):
                     fut.set_result(True)
@@ -2239,14 +2434,12 @@ class Runtime:
                 self.futures.pop(r, None)
                 self.lineage.pop(r, None)
                 self.memory_store.pop(r, None)
-            self.task_history.append({
-                "task_id": tid.hex(),
-                "name": rec.spec.name,
-                "state": rec.state,
-                "num_returns": rec.spec.num_returns,
-                "retries_left": rec.retries_left,
-                "is_actor_task": rec.spec.is_actor_task,
-            })
+            # raw tuple: this runs once per completed task, and building a
+            # keyed dict (plus .hex()) here showed in the completion hot
+            # path — the state API renders rows lazily on read
+            self.task_history.append(
+                (tid, rec.spec.name, rec.state, rec.spec.num_returns,
+                 rec.retries_left, rec.spec.is_actor_task))
             del self.tasks[tid]
             for a in self._ref_deps(rec.spec):
                 n = self._lineage_dependents.get(a, 0) - 1
@@ -2260,29 +2453,44 @@ class Runtime:
                         stack.append(ptid)
 
     def free_object(self, oid: bytes) -> None:
-        """Drop an object's value everywhere (ray.internal.free analog),
-        then try to prune the producing task's metadata (see
-        _try_prune_record_locked)."""
+        self.free_objects((oid,))
+
+    def free_objects(self, oids) -> None:
+        """Drop objects' values everywhere (ray.internal.free analog),
+        then try to prune the producing tasks' metadata (see
+        _try_prune_record_locked). Batched: completion bursts free many
+        zero-ref returns at once, and per-object lock acquisition was a
+        measurable slice of the task hot path."""
+        if not oids:
+            return
+        device_local: List[bytes] = []
+        device_remote: List[tuple] = []
         with self._lock:
-            loc = self._device_locations.pop(oid, None)
-            self.memory_store.pop(oid, None)  # the value is dead either way
-            task_id = self.lineage.get(oid)
-            if task_id is not None:
-                self._try_prune_record_locked(task_id)
-            else:
-                # a put object: no lineage, just the settled future
-                fut = self.futures.get(oid)
-                if fut is not None and fut.done():
-                    self.futures.pop(oid, None)
-        if loc == "driver":
+            for oid in oids:
+                loc = self._device_locations.pop(oid, None)
+                self.memory_store.pop(oid, None)  # value is dead either way
+                task_id = self.lineage.get(oid)
+                if task_id is not None:
+                    self._try_prune_record_locked(task_id)
+                else:
+                    # a put object: no lineage, just the settled future
+                    fut = self.futures.get(oid)
+                    if fut is not None and fut.done():
+                        self.futures.pop(oid, None)
+                if loc == "driver":
+                    device_local.append(oid)
+                elif loc is not None:
+                    device_remote.append((loc, oid))
+        for oid in device_local:
             self.device_store.delete(oid)
-        elif loc is not None:
+        for loc, oid in device_remote:
             self._send(loc, {"type": "free_device", "object_id": oid})
-        for node_id in self.gcs.get_object_locations(oid):
-            nm = self.nodes.get(node_id)
-            if nm and nm.alive:
-                nm.store.delete(oid)
-            self.gcs.remove_object_location(oid, node_id)
+        for oid in oids:
+            for node_id in self.gcs.get_object_locations(oid):
+                nm = self.nodes.get(node_id)
+                if nm and nm.alive:
+                    nm.store.delete(oid)
+                self.gcs.remove_object_location(oid, node_id)
 
     # ------------------------------------------------------ worker requests
     def _serve_worker_request(self, handle: WorkerHandle, msg: dict) -> None:
@@ -2310,7 +2518,7 @@ class Runtime:
                 oid = ObjectID.for_put().binary()
                 with self._lock:
                     self.memory_store[oid] = msg["data"]
-                    fut = Future()
+                    fut = _SlimFuture()
                     fut.set_result(True)
                     self.futures[oid] = fut
                 reply["object_id"] = oid
@@ -2327,7 +2535,7 @@ class Runtime:
                 with self._lock:
                     fut = self.futures.get(oid)
                     if fut is None:
-                        self.futures[oid] = fut = Future()
+                        self.futures[oid] = fut = _SlimFuture()
                 if not fut.done():
                     fut.set_result(True)
                 self._on_dep_ready(oid)

@@ -21,7 +21,7 @@ class TaskSpec:
         "task_id", "name", "fn_id", "args", "kwargs", "num_returns",
         "return_ids", "resources", "strategy", "max_retries",
         "retry_exceptions", "actor_id", "method", "seq",
-        "runtime_env", "placement", "depth",
+        "runtime_env", "placement", "depth", "_ref_deps_cache",
     )
 
     def __init__(
@@ -61,6 +61,22 @@ class TaskSpec:
         self.runtime_env = runtime_env
         self.placement = placement
         self.depth = depth
+        self._ref_deps_cache: Optional[List[bytes]] = None
+
+    @property
+    def ref_deps(self) -> List[bytes]:
+        """Object ids this task's args reference. Computed once: the owner
+        walks a task's deps on submit, dep-resolve, arg-pin, finish, GC
+        and recovery paths — rebuilding the list each time showed up in
+        the submit hot path. Args are immutable after construction."""
+        deps = self._ref_deps_cache
+        if deps is None:
+            deps = [payload for kind, payload in self.args if kind == "ref"]
+            for kind, payload in self.kwargs.values():
+                if kind == "ref":
+                    deps.append(payload)
+            self._ref_deps_cache = deps
+        return deps
 
     @property
     def is_actor_task(self) -> bool:

@@ -84,8 +84,14 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     with rt._lock:
         records = list(rt.tasks.items())
         # GC'd tasks stay observable through the bounded history
-        # (runtime.task_history; the reference's GcsTaskManager log)
-        rows = list(rt.task_history)
+        # (runtime.task_history; the reference's GcsTaskManager log) —
+        # stored as raw tuples on the completion hot path, rendered here
+        history = list(rt.task_history)
+    rows = [{
+        "task_id": tid.hex(), "name": name, "state": state,
+        "num_returns": nret, "retries_left": retries,
+        "is_actor_task": is_actor,
+    } for tid, name, state, nret, retries, is_actor in history]
     for task_id, rec in records:
         rows.append({
             "task_id": task_id.hex(),

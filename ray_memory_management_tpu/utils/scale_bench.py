@@ -53,7 +53,7 @@ def run_scale_suite(n_actors: int = 2000, n_tasks: int = 10_000,
 
     results: Dict[str, float] = {}
     stats: Dict[str, Dict[str, float]] = {}
-    rt = rmt.init(num_cpus=8)
+    rt = rmt.init(num_cpus=8, object_store_memory=3 << 30)
     try:
         agent_ids = [rt.add_remote_node_process(num_cpus=4)
                      for _ in range(n_agents)]

@@ -213,3 +213,95 @@ def test_dispatch_stays_responsive_during_big_transfer():
         assert min(lat) < 2.0, f"dispatch latencies during transfer: {lat}"
     finally:
         rmt.shutdown()
+
+
+class TestAnyHolderServes:
+    """Broadcast fan-out properties: every node holding a copy is a valid
+    transfer source (object_manager.h:114 — any holder serves), and
+    same-host peers move objects shm-to-shm."""
+
+    def test_replica_serves_after_producer_death(self):
+        """A serves its object to B; A dies; C must still get the object
+        — from B's copy, the only one left (the 'B can serve A's object
+        to C' contract, without which a broadcast collapses back onto the
+        producer)."""
+        rt = rmt.init(num_cpus=2)
+        try:
+            a = rt.add_remote_node_process(num_cpus=2)
+            b = rt.add_remote_node_process(num_cpus=2)
+            c = rt.add_remote_node_process(num_cpus=2)
+
+            @rmt.remote(max_retries=0)
+            def produce():
+                return np.full(2_000_000, 7.0, np.float32)  # 8 MB
+
+            @rmt.remote(max_retries=0)
+            def touch(arr):
+                return float(arr[0])
+
+            ref = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=a, soft=False)).remote()
+            # B pulls a copy (and registers as a holder)
+            assert rmt.get(touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=b, soft=False)).remote(ref), timeout=300) == 7.0
+            assert b in rt.gcs.get_object_locations(ref.binary())
+
+            rt.remove_node(a)  # producer gone; B holds the only copy
+            assert rmt.get(touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=c, soft=False)).remote(ref), timeout=300) == 7.0
+        finally:
+            rmt.shutdown()
+
+    def test_broadcast_sources_spread_over_holders(self):
+        """Concurrent pulls of one object must not all serialize on the
+        original producer: the head picks the least-loaded holder, so as
+        copies land they become sources for the stragglers."""
+        rt = rmt.init(num_cpus=2, object_store_memory=1 << 30)
+        try:
+            agents = [rt.add_remote_node_process(num_cpus=2)
+                      for _ in range(4)]
+
+            @rmt.remote(max_retries=0)
+            def touch(arr):
+                return float(arr[0])
+
+            blob = np.full(16_000_000, 3.0, np.float32)  # 64 MB
+            ref = rmt.put(blob)
+            outs = [touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid, soft=False)).remote(ref)
+                for nid in agents]
+            assert rmt.get(outs, timeout=600) == [3.0] * 4
+            # every agent ended up a registered holder
+            locs = rt.gcs.get_object_locations(ref.binary())
+            assert all(nid in locs for nid in agents)
+        finally:
+            rmt.shutdown()
+
+    def test_same_host_agent_store_mapped_directly(self):
+        """The head reads a same-host agent's store through a direct shm
+        mapping (StoreClient), not the channel proxy — the mechanism
+        behind same-host broadcast bandwidth."""
+        from ray_memory_management_tpu.core.object_store import StoreClient
+
+        rt = rmt.init(num_cpus=2)
+        try:
+            a = rt.add_remote_node_process(num_cpus=2)
+
+            @rmt.remote(max_retries=0)
+            def produce():
+                return np.arange(500_000, dtype=np.float32)  # 2 MB
+
+            ref = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=a, soft=False)).remote()
+            arr = rmt.get(ref, timeout=300)
+            assert float(arr.sum()) == float(np.arange(500_000,
+                                                       dtype=np.float32).sum())
+            cli = rt._store_clients.get(a)
+            assert isinstance(cli, StoreClient), type(cli)
+        finally:
+            rmt.shutdown()
