@@ -117,12 +117,17 @@ def _tpu_suite():
         print(f"  tpu flash bench failed: {e!r}", file=sys.stderr)
     try:
         sv = tpu_bench.llm_serving_bench()
+        ratio = sv.get("continuous_vs_barrier")
         print(
             f"  tpu serve-LM decode: {sv['decode_tokens_per_s']:,.0f} tok/s"
             f"  ({sv['requests_per_s']:.1f} req/s, "
-            f"{sv.get('batches', '?')} batches)", file=sys.stderr)
+            f"{sv.get('decode_steps', '?')} steps"
+            + (f"; {ratio:.2f}x over batch-barrier" if ratio else "")
+            + ")", file=sys.stderr)
         out["serve_decode_tokens_per_s"] = round(
             sv["decode_tokens_per_s"], 1)
+        if ratio:
+            out["serve_continuous_vs_barrier"] = round(ratio, 2)
     except Exception as e:  # pragma: no cover
         print(f"  tpu serve bench failed: {e!r}", file=sys.stderr)
     try:

@@ -439,14 +439,35 @@ class NodeAgent:
             view = cli.get(oid)  # shared-segment reader ref (plasma-style)
             if view is None:
                 return "not shm-resident at source"
+            import time as _time
+
             try:
                 try:
                     buf = self.store.create(oid, view.nbytes)
                 except ValueError:
-                    return None  # already present here
-                buf[:] = view
-                del buf
-                self.store.seal(oid)
+                    # create also refuses while a RACING fetch's copy is
+                    # still unsealed: success is only real once the object
+                    # is readable (the racer may die mid-copy and abort) —
+                    # same guard as the TCP path, transfer.py fetch_object
+                    deadline = _time.monotonic() + 30.0
+                    while _time.monotonic() < deadline:
+                        if self.store.contains(oid):
+                            return None
+                        _time.sleep(0.05)
+                    return "concurrent fetch of this object never completed"
+                try:
+                    try:
+                        buf[:] = view
+                    finally:
+                        del buf  # drop the mapping before seal/abort
+                    self.store.seal(oid)
+                except BaseException:
+                    # abort the unsealed create so retries can re-allocate
+                    try:
+                        self.store.delete(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise
                 return None
             finally:
                 cli.release(oid)

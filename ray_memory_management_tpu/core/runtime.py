@@ -179,14 +179,15 @@ class _SlimFuture:
     def done(self) -> bool:
         return self._state != 0
 
-    def _finish(self, state: int, value) -> None:
+    def _finish(self, state: int, value, notify: bool = True) -> None:
         with self._cond:
             if self._state:
                 return  # first completion wins, like the stdlib
             self._value = value
             self._state = state
             cbs, self._cbs = self._cbs, None
-            self._cond.notify_all()
+            if notify:
+                self._cond.notify_all()
         for cb in cbs or ():
             try:
                 cb(self)
@@ -204,17 +205,7 @@ class _SlimFuture:
         that call :meth:`broadcast` ONCE after resolving a whole batch
         (per-future notify_all made a parked getter context-switch per
         completion instead of per batch). Callbacks still fire here."""
-        with self._cond:
-            if self._state:
-                return
-            self._value = value
-            self._state = self._RESULT
-            cbs, self._cbs = self._cbs, None
-        for cb in cbs or ():
-            try:
-                cb(self)
-            except Exception:  # noqa: BLE001
-                pass
+        self._finish(self._RESULT, value, notify=False)
 
     @classmethod
     def broadcast(cls) -> None:
@@ -1218,11 +1209,7 @@ class Runtime:
                 # serializing on the original producer (the reference's
                 # object manager likewise pulls from any holder,
                 # object_manager.h:114)
-                src = min(locs,
-                          key=lambda l: self._xfer_serving.get(l, 0))
-                self._xfer_serving[src] = \
-                    self._xfer_serving.get(src, 0) + 1
-                to_fetch.append((oid, src))
+                to_fetch.append((oid, self._pick_transfer_source(locs)))
         if not to_fetch:
             return True
 
@@ -1254,6 +1241,25 @@ class Runtime:
             self._xfer_serving[src] = n
         else:
             self._xfer_serving.pop(src, None)
+
+    def _pick_transfer_source(self, locs) -> NodeID:
+        """Least-loaded holder, taking a serve count the caller MUST pair
+        with ``_transfer_from`` (which releases it) — the single source-
+        selection point for every transfer path."""
+        with self._lock:
+            src = min(locs, key=lambda l: self._xfer_serving.get(l, 0))
+            self._xfer_serving[src] = self._xfer_serving.get(src, 0) + 1
+        return src
+
+    def _transfer_from(self, oid: bytes, locs, dst: NodeID) -> None:
+        """Pick the best holder among ``locs`` and transfer, keeping the
+        per-node outbound-serve accounting balanced on every exit."""
+        src = self._pick_transfer_source(locs)
+        try:
+            self._transfer_object(oid, src, dst)
+        finally:
+            with self._lock:
+                self._xfer_dec_locked(src)
 
     def _local_transfer_server(self, node_id: NodeID):
         """Lazy TransferServer over a LOCAL node's store, so remote agents
@@ -1816,7 +1822,7 @@ class Runtime:
                     if l != node_id and self.nodes.get(l)
                     and self.nodes[l].alive]
             if locs:
-                self._transfer_object(oid, locs[0], node_id)
+                self._transfer_from(oid, locs, node_id)
             elif not self.nodes[node_id].store.contains(oid):
                 try:
                     self._recover_object(oid)
@@ -2637,7 +2643,7 @@ class Runtime:
                             if l != node_id and self.nodes.get(l)
                             and self.nodes[l].alive]
                     if locs:
-                        self._transfer_object(oid, locs[0], node_id)
+                        self._transfer_from(oid, locs, node_id)
                     elif not nm.store.contains(oid):
                         self._recover_object(oid)
                         # recovery may produce an inline value
@@ -2653,7 +2659,7 @@ class Runtime:
                                     and self.nodes[l].alive]
                             if not locs:
                                 raise ObjectLostError(oid.hex())
-                            self._transfer_object(oid, locs[0], node_id)
+                            self._transfer_from(oid, locs, node_id)
                 except (ObjectStoreFullError, ObjectLostError):
                     # the worker's node cannot take a copy right now (store
                     # full past the wait budget): serve the bytes inline

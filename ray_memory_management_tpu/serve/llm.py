@@ -146,7 +146,8 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_new_tokens: int = 32, temperature: float = 0.0,
-                 pad_multiple: int = 64, seed: int = 0):
+                 pad_multiple: int = 64, seed: int = 0,
+                 steps_per_iter: int = 8):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -160,6 +161,13 @@ class ContinuousBatcher:
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.pad_multiple = pad_multiple
+        # scheduling quantum: each engine iteration decodes K tokens for
+        # every occupied row inside ONE compiled lax.scan — per-step
+        # Python dispatch would otherwise eat the step-granularity win
+        # (the barrier mode scans its whole budget in one program; K
+        # amortizes dispatch K-fold while arrivals still join within K
+        # steps and finished rows retire within K steps)
+        self.steps_per_iter = max(1, min(steps_per_iter, max_new_tokens))
         self._key = jax.random.PRNGKey(seed)
         self._cache = gpt.init_kv_cache(cfg, max_slots, cfg.max_seq)
         self._prefill_cache: Dict[int, Any] = {}  # bucket -> compiled fn
@@ -169,12 +177,22 @@ class ContinuousBatcher:
                 return jax.random.categorical(key, logits / self.temperature)
             return jnp.argmax(logits, axis=-1)
 
-        def step_fn(params, cache, last, offsets, key):
-            logits, cache = gpt.forward_with_cache_rows(
-                params, last[:, None], cache, offsets, cfg)
-            return cache, _sample(logits[:, 0], key)
+        K = self.steps_per_iter
 
-        # donate the cache so each step updates it in place on device
+        def step_fn(params, cache, last, offsets, key):
+            def body(carry, t):
+                cache, last, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = gpt.forward_with_cache_rows(
+                    params, last[:, None], cache, offsets + t, cfg)
+                nxt = _sample(logits[:, 0], sub)
+                return (cache, nxt, key), nxt
+
+            (cache, _, _), toks = jax.lax.scan(
+                body, (cache, last, key), jnp.arange(K))
+            return cache, toks  # [K, B]
+
+        # donate the cache so each iteration updates it in place on device
         # instead of allocating a fresh multi-hundred-MB copy
         self._step = jax.jit(step_fn, donate_argnums=(1,))
         self._sample = _sample
@@ -321,18 +339,24 @@ class ContinuousBatcher:
                 if not active:
                     continue
                 self._key, sub = self._jax.random.split(self._key)
-                self._cache, nxt = self._step(
+                self._cache, toks = self._step(
                     self.params, self._cache,
                     jnp.asarray(self._slot_last),
                     jnp.asarray(self._slot_offset), sub)
-                nxt = np.asarray(nxt)
-                self.steps += 1
+                toks = np.asarray(toks)  # [K, B]
+                self.steps += self.steps_per_iter
                 for r in active:
-                    tok = int(nxt[r])
-                    self._slot_out[r].append(tok)
-                    self._slot_last[r] = tok
-                    self._slot_offset[r] += 1
-                    self._slot_budget[r] -= 1
+                    # a row finishing mid-iteration consumes only what its
+                    # budget allows; the surplus decoded junk wrote into
+                    # its OWN cache rows beyond its end, which the per-row
+                    # mask keeps invisible and the next prefill overwrites
+                    take = min(self.steps_per_iter,
+                               int(self._slot_budget[r]))
+                    self._slot_out[r].extend(
+                        int(toks[t, r]) for t in range(take))
+                    self._slot_last[r] = int(toks[take - 1, r])
+                    self._slot_offset[r] += take
+                    self._slot_budget[r] -= take
                     if self._slot_budget[r] <= 0:
                         self._retire(r)
             except BaseException as e:  # noqa: BLE001 — fail loudly to

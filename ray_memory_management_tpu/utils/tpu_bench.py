@@ -189,12 +189,19 @@ def flash_attention_bench(seq_lens=(1024, 4096, 8192), bh: int = 4,
 def llm_serving_bench(preset: str = "gpt2-small", n_requests: int = 32,
                       prompt_len: int = 128, max_new_tokens: int = 64,
                       max_batch_size: int = 8) -> Dict[str, float]:
-    """Decode tokens/s through the FULL serve stack on the chip: handle ->
-    router -> replica (num_tpus=1 chip lease) -> DynamicBatcher -> one
-    KV-cached generate per coalesced batch (serve/llm.py). The measured
-    rate is end-to-end: request transport + batching + prefill + decode."""
+    """Decode goodput (REQUESTED tokens/s) through the FULL serve stack
+    on the chip: handle -> router -> replica (num_tpus=1 chip lease) ->
+    batching engine -> the KV-cached decode programs (serve/llm.py).
+    Runs BOTH batching modes over the same Poisson arrival schedule of a
+    MIXED workload (budgets alternate max_new_tokens and a quarter of
+    it) — "continuous" (decode-step join/leave, per-request budgets
+    honored, the default) vs the legacy "barrier" (whole-batch: every
+    request pays the full deployment budget and new arrivals park behind
+    the longest running batch) — and reports the speedup."""
     import os
     import threading
+
+    import numpy as np
 
     prev_worker_platform = os.environ.get("RMT_WORKER_JAX_PLATFORMS")
     os.environ["RMT_WORKER_JAX_PLATFORMS"] = "tpu"
@@ -205,46 +212,75 @@ def llm_serving_bench(preset: str = "gpt2-small", n_requests: int = 32,
 
         rmt.init(num_cpus=4, num_tpus=1)
         try:
-            serve.start(http_port=None)
-            handle = serve.run(llm_deployment(
-                preset, ray_actor_options={"num_tpus": 1},
-                max_new_tokens=max_new_tokens,
-                max_batch_size=max_batch_size,
-                batch_wait_timeout_s=0.02))
+            out: Dict[str, float] = {}
             prompt = list(range(2, 2 + prompt_len))
-            # warm: compiles the (bucket, steps) program on the chip
-            out = rmt.get(handle.remote({"tokens": prompt}), timeout=900)
-            assert len(out["tokens"]) == max_new_tokens
-
-            results: list = []
-
-            def one(i):
-                r = rmt.get(handle.remote({"tokens": prompt}), timeout=900)
-                results.append(len(r["tokens"]))
-
-            t0 = time.perf_counter()
-            threads = [threading.Thread(target=one, args=(i,))
+            # Poisson arrivals at ~2x the barrier's drain rate so queueing
+            # pressure is real; same arrival schedule for both modes
+            rng = np.random.default_rng(0)
+            gaps = rng.exponential(0.05, n_requests)  # drawn ONCE: both
+            # mixed budgets: half the requests want a quarter the tokens
+            budgets = [max_new_tokens if i % 2 == 0 else
+                       max(1, max_new_tokens // 4)
                        for i in range(n_requests)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
-            assert len(results) == n_requests
-            stats = None
-            try:
-                stats = rmt.get(handle.stats.remote(), timeout=60)
-            except Exception:
-                pass
-            out = {
-                "decode_tokens_per_s": n_requests * max_new_tokens / dt,
-                "requests_per_s": n_requests / dt,
-            }
-            if stats:
-                out["batches"] = stats["batches"]
+            requested = sum(budgets)
+            for mode in ("continuous", "barrier"):    # modes see the same
+                # arrival schedule, so the ratio measures the batching
+                # mode, not arrival-pattern noise
+                serve.start(http_port=None)
+                handle = serve.run(llm_deployment(
+                    preset, ray_actor_options={"num_tpus": 1},
+                    max_new_tokens=max_new_tokens,
+                    max_batch_size=max_batch_size,
+                    batch_wait_timeout_s=0.02,
+                    batching=mode))
+                # warm: compiles the decode programs on the chip
+                warm = rmt.get(handle.remote({"tokens": prompt}),
+                               timeout=900)
+                assert len(warm["tokens"]) == max_new_tokens
+
+                results: list = []
+
+                def one(budget):
+                    r = rmt.get(handle.remote(
+                        {"tokens": prompt, "max_new_tokens": budget}),
+                        timeout=900)
+                    results.append(len(r["tokens"]))
+
+                t0 = time.perf_counter()
+                threads = []
+                for i in range(n_requests):
+                    th = threading.Thread(target=one, args=(budgets[i],))
+                    th.start()
+                    threads.append(th)
+                    time.sleep(float(gaps[i]))
+                for th in threads:
+                    th.join()
+                dt = time.perf_counter() - t0
+                assert len(results) == n_requests
+                # goodput: tokens the CLIENTS asked for per second
+                # (barrier mode over-generates for short requests; those
+                # surplus tokens are waste, not throughput)
+                key = ("decode_tokens_per_s" if mode == "continuous"
+                       else "decode_tokens_per_s_barrier")
+                out[key] = requested / dt
+                if mode == "continuous":
+                    out["requests_per_s"] = n_requests / dt
+                    try:
+                        stats = rmt.get(handle.stats.remote(), timeout=60)
+                        out["decode_steps"] = stats["batches"]
+                    except Exception:
+                        pass
+                serve.shutdown()
+            if out.get("decode_tokens_per_s_barrier"):
+                out["continuous_vs_barrier"] = (
+                    out["decode_tokens_per_s"]
+                    / out["decode_tokens_per_s_barrier"])
             return out
         finally:
-            serve.shutdown()
+            try:
+                serve.shutdown()
+            except Exception:
+                pass
             rmt.shutdown()
     finally:
         if prev_worker_platform is None:
