@@ -439,22 +439,12 @@ class NodeAgent:
             view = cli.get(oid)  # shared-segment reader ref (plasma-style)
             if view is None:
                 return "not shm-resident at source"
-            import time as _time
+            from .transfer import create_or_wait
 
             try:
-                try:
-                    buf = self.store.create(oid, view.nbytes)
-                except ValueError:
-                    # create also refuses while a RACING fetch's copy is
-                    # still unsealed: success is only real once the object
-                    # is readable (the racer may die mid-copy and abort) —
-                    # same guard as the TCP path, transfer.py fetch_object
-                    deadline = _time.monotonic() + 30.0
-                    while _time.monotonic() < deadline:
-                        if self.store.contains(oid):
-                            return None
-                        _time.sleep(0.05)
-                    return "concurrent fetch of this object never completed"
+                buf, race_err = create_or_wait(self.store, oid, view.nbytes)
+                if buf is None:
+                    return race_err  # None: racing copy became readable
                 try:
                     try:
                         buf[:] = view

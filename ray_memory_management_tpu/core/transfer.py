@@ -142,6 +142,28 @@ class TransferServer:
             pass
 
 
+def create_or_wait(dst_store, oid: bytes, size: int, timeout: float = 30.0):
+    """Allocate ``oid`` in ``dst_store``, handling the racing-fetch case:
+    create() refuses while another fetch's copy of the SAME object is
+    unsealed and in flight, and success is only real once the object is
+    actually readable (the racer may die mid-stream and abort its
+    partial copy — so create is RETRIED, not just waited out). Shared by
+    the TCP pull and the same-host shm copy. Returns (buf, None) on a
+    fresh allocation, (None, None) when the racing copy became readable,
+    (None, error) on timeout."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return dst_store.create(oid, size), None
+        except ValueError:
+            pass
+        if dst_store.contains(oid):
+            return None, None
+        if time.monotonic() >= deadline:
+            return None, "concurrent transfer of this object never completed"
+        time.sleep(0.05)
+
+
 def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                  dst_store, chunk_size: int,
                  timeout: float = 120.0) -> Optional[str]:
@@ -181,19 +203,10 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
         if err:
             return err
         size = hdr["size"]
-        try:
-            buf = dst_store.create(oid, size)
-        except ValueError:
-            # create also refuses while a RACING fetch's copy is still
-            # unsealed and in flight — success is only real once the
-            # object is actually readable (the racer may die mid-stream
-            # and reclaim its partial copy)
-            deadline = time.monotonic() + min(timeout, 30.0)
-            while time.monotonic() < deadline:
-                if dst_store.contains(oid):
-                    return None
-                time.sleep(0.05)
-            return "concurrent transfer of this object never completed"
+        buf, race_err = create_or_wait(dst_store, oid, size,
+                                       timeout=min(timeout, 30.0))
+        if buf is None:
+            return race_err  # None: the racing copy became readable
         got = 0
         try:
             while got < size:

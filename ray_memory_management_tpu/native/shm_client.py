@@ -126,9 +126,19 @@ class ShmStore:
     @staticmethod
     def _prefault_ok(capacity: int) -> bool:
         """Populating dirties the WHOLE arena as resident tmpfs — only do
-        it when that commit is clearly affordable (< 1/4 of MemAvailable),
-        so a store sized near host RAM keeps lazy page commit."""
+        it when that commit is clearly affordable (< 1/4 of MemAvailable)
+        AND the arena is modest (<= 1 GiB): beyond that the kernel-side
+        cost of thousands of worker processes mapping a fully-resident
+        multi-GB shared file dominates worker spawn (measured: 2,000 live
+        workers spawn at ~90/s against a sparse 3 GiB store but ~30/s
+        against a populated one), which is a far worse trade than lazy
+        first-touch faults on large writes."""
         if os.environ.get("RMT_DISABLE_PREFAULT"):
+            return False
+        if (capacity > (1 << 30)
+                and not os.environ.get("RMT_FORCE_PREFAULT")):
+            # RMT_FORCE_PREFAULT=1 opts a large-store, few-worker
+            # deployment (bulk ingest) back into first-touch-free writes
             return False
         try:
             with open("/proc/meminfo") as f:

@@ -383,7 +383,8 @@ class LLMServer:
                  temperature: float = 0.0,
                  pad_multiple: int = 64,
                  seed: int = 0,
-                 batching: str = "continuous"):
+                 batching: str = "continuous",
+                 steps_per_iter: int = 8):
         import jax
 
         from ..models import gpt
@@ -404,12 +405,14 @@ class LLMServer:
         self._key = jax.random.PRNGKey(seed + 1)
         self._stats = {"requests": 0, "batches": 0, "generated_tokens": 0}
         self.batching = batching
+        self.steps_per_iter = steps_per_iter
         if batching == "continuous":
             # decode-step-granular join/leave + exact per-row positions
             self._engine = ContinuousBatcher(
                 self.params, self.cfg, max_slots=max_batch_size,
                 max_new_tokens=max_new_tokens, temperature=temperature,
-                pad_multiple=pad_multiple, seed=seed + 1)
+                pad_multiple=pad_multiple, seed=seed + 1,
+                steps_per_iter=steps_per_iter)
             self._batcher = None
         elif batching == "barrier":
             # legacy whole-batch mode (kept for A/B benchmarking)
@@ -444,7 +447,8 @@ class LLMServer:
             self._engine = ContinuousBatcher(
                 self.params, self.cfg, max_slots=self.max_batch_size,
                 max_new_tokens=new_tokens, temperature=new_temp,
-                pad_multiple=self.pad_multiple, seed=self.seed + 1)
+                pad_multiple=self.pad_multiple, seed=self.seed + 1,
+                steps_per_iter=self.steps_per_iter)
             old.close()
 
     # -- request surface ------------------------------------------------------
