@@ -16,6 +16,14 @@ from .env import (  # noqa: F401
     register_env,
 )
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    DatasetReader,
+    DatasetWriter,
+    collect_dataset,
+    importance_sampling_estimate,
+)
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401

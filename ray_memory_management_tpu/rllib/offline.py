@@ -1,0 +1,314 @@
+"""Offline RL: dataset IO, behavior cloning, and off-policy evaluation.
+
+The reference's offline stack (rllib/offline/: json_writer.py:31 /
+json_reader.py:198 dataset IO, estimators/importance_sampling.py off-policy
+evaluation; BC is the reference's simplest offline algorithm, built on the
+same input pipeline). TPU-first shape: datasets are columnar ``.npz``
+shards — the exact arrays jax consumes, written zero-copy from sample
+batches — rather than row-wise JSON; the BC update (policy forward,
+cross-entropy, Adam) is one jit'd XLA program fed contiguous minibatches.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import make_env
+from .models import mlp_apply, mlp_init
+
+# behavior-policy action log-prob column (needed for off-policy evaluation)
+BEHAVIOR_LOGP = sb.LOGP
+
+
+class DatasetWriter:
+    """Append sample batches to a directory of columnar ``.npz`` shards
+    (the OutputWriter/JsonWriter contract, json_writer.py:31,72 — with
+    arrays instead of rows)."""
+
+    def __init__(self, path: str, shard_size: int = 10_000):
+        self.path = path
+        self.shard_size = shard_size
+        os.makedirs(path, exist_ok=True)
+        self._buf: List[Dict[str, np.ndarray]] = []
+        self._buffered = 0
+        self._shard = 0
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        self._buf.append({k: np.asarray(v) for k, v in batch.items()})
+        self._buffered += sb.batch_size(batch)
+        if self._buffered >= self.shard_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        merged = sb.concat_batches(self._buf)
+        fname = os.path.join(
+            self.path, f"shard-{os.getpid()}-{self._shard:05d}.npz")
+        np.savez_compressed(fname + ".tmp.npz", **merged)
+        os.replace(fname + ".tmp.npz", fname)  # readers never see partials
+        self._shard += 1
+        self._buf = []
+        self._buffered = 0
+
+    def close(self) -> None:
+        self.flush()
+
+
+class DatasetReader:
+    """Load a shard directory; serve shuffled minibatches (the
+    InputReader/JsonReader contract, json_reader.py:198,264)."""
+
+    def __init__(self, path: str, seed: int = 0):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".npz") and not f.endswith(".tmp.npz"))
+        if not files:
+            raise FileNotFoundError(f"no dataset shards under {path}")
+        shards = [dict(np.load(f)) for f in files]
+        self.data = sb.concat_batches(shards)
+        self.num_samples = sb.batch_size(self.data)
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self.num_samples, size=n)
+        return {k: v[idx] for k, v in self.data.items()}
+
+    def iter_episodes(self, include_partial: bool = False
+                      ) -> Iterator[Dict[str, np.ndarray]]:
+        """Split the (time-ordered) data at terminal flags — what the
+        trajectory-level OPE estimators consume. A trailing fragment with
+        no terminal flag is a TRUNCATED recording, not an episode: it is
+        excluded by default (treating it as complete biases per-episode
+        return estimates low; the reference's estimators likewise consume
+        only completed episodes)."""
+        dones = self.data[sb.DONES]
+        start = 0
+        for t in range(len(dones)):
+            if dones[t]:
+                yield {k: v[start:t + 1] for k, v in self.data.items()}
+                start = t + 1
+        if include_partial and start < len(dones):
+            yield {k: v[start:] for k, v in self.data.items()}
+
+
+def collect_dataset(env_spec, path: str, num_steps: int = 10_000,
+                    policy=None, env_config: Optional[dict] = None,
+                    seed: int = 0, shard_size: int = 10_000) -> str:
+    """Roll a policy (default: uniform random) through the env and write
+    (obs, action, reward, done, behavior logp) shards — the offline
+    counterpart of the reference's ``output`` rollout recording."""
+    env = make_env(env_spec, env_config)
+    rng = np.random.default_rng(seed)
+    writer = DatasetWriter(path, shard_size=shard_size)
+    obs = env.reset(seed=seed)
+    n_act = env.num_actions
+
+    def fresh() -> Dict[str, List]:
+        return {sb.OBS: [], sb.ACTIONS: [], sb.REWARDS: [],
+                sb.DONES: [], BEHAVIOR_LOGP: []}
+
+    def emit(cols: Dict[str, List]) -> None:
+        writer.write({
+            sb.OBS: np.asarray(cols[sb.OBS], np.float32),
+            sb.ACTIONS: np.asarray(cols[sb.ACTIONS], np.int32),
+            sb.REWARDS: np.asarray(cols[sb.REWARDS], np.float32),
+            sb.DONES: np.asarray(cols[sb.DONES], np.float32),
+            BEHAVIOR_LOGP: np.asarray(cols[BEHAVIOR_LOGP], np.float32),
+        })
+
+    cols = fresh()
+    for _ in range(num_steps):
+        if policy is None:
+            a = int(rng.integers(n_act))
+            logp = -float(np.log(n_act))
+        else:
+            a, logp = policy(obs)
+        nxt, reward, terminated, truncated, _ = env.step(a)
+        cols[sb.OBS].append(obs)
+        cols[sb.ACTIONS].append(a)
+        cols[sb.REWARDS].append(reward)
+        cols[sb.DONES].append(float(terminated or truncated))
+        cols[BEHAVIOR_LOGP].append(logp)
+        obs = nxt
+        if terminated or truncated:
+            obs = env.reset(seed=int(rng.integers(1 << 31)))
+        if len(cols[sb.ACTIONS]) >= shard_size:
+            # hand rows to the writer as we go: memory stays O(shard),
+            # not O(num_steps), and shard_size actually shards
+            emit(cols)
+            cols = fresh()
+    if cols[sb.ACTIONS]:
+        emit(cols)
+    writer.close()
+    return path
+
+
+class BC(Algorithm):
+    """Behavior cloning: supervised cross-entropy on a recorded dataset —
+    the reference's BC algorithm (rllib/algorithms/bc), the simplest
+    member of its offline family. No environment interaction during
+    training; periodic greedy eval rollouts supply episode metrics."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import optax
+
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.reader = DatasetReader(config["input_path"], seed=seed)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        self.eval_env = probe_env
+        hidden = config.get("hidden", (64, 64))
+        self.params = {"pi": mlp_init(
+            jax.random.key(seed),
+            [probe_env.observation_dim, *hidden, probe_env.num_actions])}
+        self.optimizer = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.optimizer.init(self.params)
+        self.train_batch_size = config.get("train_batch_size", 256)
+        self.updates_per_step = config.get("updates_per_step", 64)
+        self.eval_episodes = config.get("eval_episodes", 2)
+        self._updates_done = 0
+        self._timesteps_total = 0  # offline: no env steps are sampled
+        self.workers = None
+        self.local_worker = None
+
+        import jax.numpy as jnp
+
+        def loss_fn(params, obs, actions):
+            logits = mlp_apply(params["pi"], obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None], axis=-1)[:, 0]
+            acc = (jnp.argmax(logits, -1) == actions).mean()
+            return nll.mean(), acc
+
+        @jax.jit
+        def update(params, opt_state, obs, actions):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions)
+            upd, opt_state = self.optimizer.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, upd)
+            return params, opt_state, loss, acc
+
+        self._update = update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        loss = acc = 0.0
+        for _ in range(self.updates_per_step):
+            mb = self.reader.sample(self.train_batch_size)
+            self.params, self.opt_state, loss, acc = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(mb[sb.OBS]),
+                jnp.asarray(mb[sb.ACTIONS].astype(np.int32)))
+            self._updates_done += 1
+        out = {
+            "bc_loss": float(loss),
+            "action_match": float(acc),
+            "num_updates": self._updates_done,
+            "dataset_size": self.reader.num_samples,
+            "learn_time_s": time.time() - t0,
+        }
+        out.update(self._evaluate())
+        return out
+
+    def _evaluate(self) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(self.eval_episodes):
+            obs = self.eval_env.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = self.eval_env.step(a)
+                total += r
+                done = term or trunc
+            rewards.append(total)
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes_total": len(rewards)}
+
+    def _episode_metrics(self) -> Dict[str, Any]:
+        return {}  # offline: metrics come from the eval rollouts above
+
+    def _sync_weights(self) -> None:
+        pass  # offline: no rollout workers exist to receive weights
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+
+        logits = mlp_apply(self.params["pi"], jnp.asarray(obs[None, :]))
+        return int(np.asarray(logits)[0].argmax())
+
+    def _save_extra_state(self):
+        from .models import params_to_numpy
+
+        return {"opt_state": params_to_numpy(self.opt_state),
+                "updates_done": self._updates_done}
+
+    def _load_extra_state(self, state) -> None:
+        from .models import params_from_numpy
+
+        if not state:
+            return
+        if "opt_state" in state:
+            self.opt_state = params_from_numpy(state["opt_state"])
+        self._updates_done = state.get("updates_done", 0)
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(BC)
+        self.extra.update({"updates_per_step": 64, "eval_episodes": 2})
+
+    def offline_data(self, *, input_path: str) -> "BCConfig":
+        self.extra["input_path"] = input_path
+        return self
+
+    def training(self, *, updates_per_step=None, eval_episodes=None,
+                 **kwargs) -> "BCConfig":
+        super().training(**kwargs)
+        if updates_per_step is not None:
+            self.extra["updates_per_step"] = updates_per_step
+        if eval_episodes is not None:
+            self.extra["eval_episodes"] = eval_episodes
+        return self
+
+
+def importance_sampling_estimate(reader: DatasetReader, target_logp,
+                                 gamma: float = 0.99) -> Dict[str, float]:
+    """Off-policy evaluation of a target policy from behavior data:
+    ordinary (IS) and weighted (WIS) per-episode importance sampling
+    (rllib/offline/estimators/importance_sampling.py). ``target_logp``
+    maps (obs [T, D], actions [T]) -> log-probs [T] under the policy
+    being evaluated; the dataset supplies the behavior log-probs."""
+    ep_returns = []
+    ep_weights = []
+    for ep in reader.iter_episodes():
+        T = sb.batch_size(ep)
+        discounts = gamma ** np.arange(T)
+        ret = float(np.sum(ep[sb.REWARDS] * discounts))
+        logp_t = np.asarray(target_logp(ep[sb.OBS], ep[sb.ACTIONS]),
+                            np.float64)
+        log_ratio = np.clip(logp_t - ep[BEHAVIOR_LOGP], -30.0, 30.0)
+        ep_weights.append(float(np.exp(np.sum(log_ratio))))
+        ep_returns.append(ret)
+    w = np.asarray(ep_weights)
+    r = np.asarray(ep_returns)
+    return {
+        "behavior_mean_return": float(r.mean()),
+        "is_estimate": float(np.mean(w * r)),
+        "wis_estimate": float(np.sum(w * r) / max(np.sum(w), 1e-12)),
+        "episodes": len(r),
+        "effective_sample_size": float(
+            np.sum(w) ** 2 / max(np.sum(w ** 2), 1e-12)),
+    }
