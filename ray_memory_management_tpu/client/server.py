@@ -4,6 +4,13 @@ The reference's client server + proxier (util/client/server/{server,
 proxier,dataservicer}.py) collapsed to one in-driver service: each client
 connection gets a handler thread; requests reuse the same operations the
 worker-request path serves, with object values inlined over the wire.
+
+Every connection is a JOB (the GcsJobManager model, gcs_job_manager.h:28):
+it registers in the GCS job table on connect, its created resources are
+tracked, and on disconnect everything non-detached it created — actors,
+placement groups, put objects — is reclaimed and the job row flips to
+FINISHED. This is the multi-driver isolation story: two clients sharing a
+cluster cannot leak resources into each other's lifetime.
 """
 
 from __future__ import annotations
@@ -14,6 +21,22 @@ from typing import Any, Dict, Optional
 
 from .. import _worker_context
 from .. import serialization as ser
+from ..ids import JobID
+
+
+class _JobState:
+    """Per-connection resource ledger, reclaimed on disconnect."""
+
+    __slots__ = ("job_id", "actors", "pgs", "puts", "mu", "closed")
+
+    def __init__(self, job_id: bytes):
+        self.job_id = job_id
+        self.actors: set = set()
+        self.pgs: set = set()
+        self.puts: set = set()
+        self.mu = threading.Lock()
+        self.closed = False  # set by _reclaim_job; late tracks reclaim
+        # inline instead of landing in an already-drained ledger
 
 
 class ClusterServer:
@@ -54,6 +77,8 @@ class ClusterServer:
 
     def _serve_conn(self, conn) -> None:
         send_lock = threading.Lock()
+        job = _JobState(JobID.from_random().binary())
+        self._rt.gcs.register_job(job.job_id, {"type": "client"})
         try:
             while not self._stop.is_set():
                 try:
@@ -61,7 +86,7 @@ class ClusterServer:
                 except (EOFError, OSError):
                     return
                 threading.Thread(
-                    target=self._handle, args=(conn, send_lock, msg),
+                    target=self._handle, args=(conn, send_lock, msg, job),
                     daemon=True).start()
         finally:
             with self._conns_lock:
@@ -70,10 +95,64 @@ class ClusterServer:
                 conn.close()
             except OSError:
                 pass
+            self._reclaim_job(job)
 
-    def _handle(self, conn, send_lock, msg: Dict[str, Any]) -> None:
+    def _reclaim_job(self, job: _JobState) -> None:
+        """Disconnect cleanup: kill the job's non-detached actors, remove
+        its placement groups, free its put objects, finish its job row —
+        the reference kills a driver's leases and actors on driver death
+        the same way (gcs_job_manager.h:28 MarkJobFinished)."""
+        rt = self._rt
+        with job.mu:
+            job.closed = True
+            actors, pgs, puts = list(job.actors), list(job.pgs), \
+                list(job.puts)
+            job.actors.clear()
+            job.pgs.clear()
+            job.puts.clear()
+        for aid in actors:
+            self._reclaim_one("actors", aid)
+        for pg_id in pgs:
+            self._reclaim_one("pgs", pg_id)
+        try:
+            rt.free_objects(puts)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            rt.gcs.set_job_state(job.job_id, "FINISHED")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _reclaim_one(self, kind: str, value) -> None:
+        rt = self._rt
+        try:
+            if kind == "actors":
+                info = rt.actors.get(value)
+                if info is not None and not info.spec.detached:
+                    rt.kill_actor(value, no_restart=True)
+            elif kind == "pgs":
+                from ..core.placement_group import _manager
+
+                _manager(rt).remove(value)
+            elif kind == "puts":
+                rt.free_objects([value])
+        except Exception:  # noqa: BLE001 — reclaim is best-effort
+            pass
+
+    def _handle(self, conn, send_lock, msg: Dict[str, Any],
+                job: _JobState) -> None:
         reply: Dict[str, Any] = {"req_id": msg.get("req_id"), "error": None}
         rt = self._rt
+
+        def track(kind: str, value) -> None:
+            with job.mu:
+                if not job.closed:
+                    getattr(job, kind).add(value)
+                    return
+            # the client vanished mid-request and reclaim already ran:
+            # this straggler resource would leak forever — reclaim it now
+            self._reclaim_one(kind, value)
+
         try:
             mtype = msg["type"]
             if mtype == "submit_task":
@@ -84,14 +163,17 @@ class ClusterServer:
                     msg["payload"], adopt_returns=False)
             elif mtype == "create_actor":
                 reply["actor_id"] = rt.create_actor(msg["payload"])
+                track("actors", reply["actor_id"])
             elif mtype == "get_objects":
                 values = rt.get_objects(msg["oids"], msg.get("timeout"))
                 reply["values"] = [ser.dumps(v) for v in values]
             elif mtype == "put":
                 reply["object_id"] = rt.put_object(ser.loads(msg["data"]))
+                track("puts", reply["object_id"])
             elif mtype == "put_device":
                 reply["object_id"] = rt.put_device_object(
                     ser.loads(msg["data"]))
+                track("puts", reply["object_id"])
             elif mtype == "wait":
                 ready, not_ready = rt.wait(
                     msg["oids"], msg["num_returns"], msg["timeout"])
@@ -113,6 +195,7 @@ class ClusterServer:
                 pg = _manager(rt).create(
                     msg["bundles"], msg["strategy"], msg.get("name", ""))
                 reply["pg_id"] = pg.id
+                track("pgs", pg.id)
             elif mtype == "pg_state":
                 from ..core.placement_group import _manager
 

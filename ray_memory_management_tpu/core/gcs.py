@@ -102,6 +102,37 @@ class GCS:
         self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
         self._node_index = 0
 
+    # -- jobs ----------------------------------------------------------------
+    # The job table (GcsJobManager analog, gcs_job_manager.h:28): one row
+    # per driver — the in-process driver plus every connected thin client.
+    # Rows outlive the job (state flips to FINISHED/FAILED) so the state
+    # API can show what ran.
+    def register_job(self, job_id: bytes, info: Optional[dict] = None
+                     ) -> None:
+        with self._lock:
+            self.jobs[job_id] = {
+                "job_id": job_id.hex(),
+                "state": "RUNNING",
+                "start_time": time.time(),
+                "end_time": None,
+                **(info or {}),
+            }
+
+    def set_job_state(self, job_id: bytes, state: str,
+                      message: str = "") -> None:
+        with self._lock:
+            row = self.jobs.get(job_id)
+            if row is None:
+                return
+            row["state"] = state
+            row["end_time"] = time.time()
+            if message:
+                row["message"] = message
+
+    def list_jobs(self) -> list:
+        with self._lock:
+            return [dict(v) for v in self.jobs.values()]
+
     # -- nodes ---------------------------------------------------------------
     def register_node(self, node_id: NodeID, resources: NodeResources,
                       store_name: str,

@@ -286,6 +286,12 @@ class Runtime:
         from .gcs_storage import open_storage
 
         self.gcs = GCS(open_storage(config.gcs_storage_path))
+        import sys as _sys
+
+        self.gcs.register_job(self.job_id.binary(), {
+            "type": "driver",
+            "entrypoint": " ".join(_sys.argv[:2]) or "driver",
+        })
         self.scheduler = ClusterScheduler(
             self.gcs, config, load_fn=self._node_queue_depth)
         self.nodes: Dict[NodeID, NodeManager] = {}
@@ -2793,6 +2799,10 @@ class Runtime:
 
     def shutdown(self) -> None:
         self._stop.set()
+        try:
+            self.gcs.set_job_state(self.job_id.binary(), "FINISHED")
+        except Exception:  # noqa: BLE001
+            pass
         self._wakeup()
         with self._send_cond:
             channels = list(self._send_channels.values())

@@ -8,6 +8,7 @@ plus the GCS global-state reads in ray._private.state.
 from .api import (  # noqa: F401
     list_actors,
     list_cluster_events,
+    list_jobs,
     list_nodes,
     list_objects,
     list_placement_groups,

@@ -146,6 +146,14 @@ def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     return _apply_filters(rows, filters)[:limit]
 
 
+def list_jobs(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+    """Job table rows (the driver plus every thin-client connection; the
+    reference's list_jobs over the GcsJobManager table,
+    gcs_job_manager.h:28)."""
+    rt = _runtime()
+    return _apply_filters(rt.gcs.list_jobs(), filters)[:limit]
+
+
 def list_workers(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     rt = _runtime()
     rows = []

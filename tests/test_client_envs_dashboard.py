@@ -213,3 +213,80 @@ class TestDashboard:
             assert status == 404
         finally:
             stop_dashboard()
+
+
+class TestJobs:
+    """Job table + per-client resource isolation (GcsJobManager analog,
+    gcs_job_manager.h:28): every client connection is a job; disconnect
+    reclaims its non-detached actors, PGs, and put objects."""
+
+    def test_driver_job_registered(self, rmt_start_regular):
+        from ray_memory_management_tpu import state
+
+        jobs = state.list_jobs()
+        assert len(jobs) == 1
+        assert jobs[0]["state"] == "RUNNING"
+        assert jobs[0]["type"] == "driver"
+
+    def test_client_job_lifecycle_and_reclaim(self, rmt_start_regular):
+        import subprocess
+        import sys
+        import time
+
+        from ray_memory_management_tpu import state
+        from ray_memory_management_tpu.core.runtime import ACTOR_DEAD
+
+        rt = rmt_start_regular
+        server = ClusterServer(port=0)
+        try:
+            script = f"""
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu.client import connect
+connect("127.0.0.1:{server.port}")
+
+@rmt.remote
+class JobCounter:
+    def __init__(self): self.n = 0
+    def inc(self):
+        self.n += 1
+        return self.n
+
+a = JobCounter.options(name="job_actor").remote()
+assert rmt.get(a.inc.remote()) == 1
+r = rmt.put({{"who": "client"}})
+print("OID", r.hex(), flush=True)
+print("CLIENT OK", flush=True)
+import os
+os._exit(0)  # vanish without cleanup: the server must reclaim
+"""
+            out = subprocess.run([sys.executable, "-c", script],
+                                 capture_output=True, text=True,
+                                 timeout=240)
+            assert "CLIENT OK" in out.stdout, out.stderr
+            oid = bytes.fromhex(
+                [ln for ln in out.stdout.splitlines()
+                 if ln.startswith("OID ")][0].split()[1])
+
+            # disconnect reclaims: actor killed, job row FINISHED
+            deadline = time.monotonic() + 30
+            rec = None
+            jobs = []
+            while time.monotonic() < deadline:
+                jobs = state.list_jobs(filters=[("type", "=", "client")])
+                recs = [r for r in rt.gcs.actors.values()
+                        if r.state == ACTOR_DEAD]
+                if (jobs and jobs[0]["state"] == "FINISHED" and recs):
+                    rec = recs[0]
+                    break
+                time.sleep(0.1)
+            assert jobs and jobs[0]["state"] == "FINISHED", jobs
+            assert rec is not None, "client actor was not reclaimed"
+            # the reclaimed actor is gone from the living set
+            assert rt.gcs.get_named_actor("job_actor") is None
+            # the client's put object was freed with the job
+            with rt._lock:
+                assert oid not in rt.memory_store
+        finally:
+            server.close()
+
+
