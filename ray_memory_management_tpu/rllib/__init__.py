@@ -7,6 +7,14 @@ weights broadcast through the object store.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .connectors import (  # noqa: F401
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    ObsNormalizer,
+    register_connector,
+)
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,

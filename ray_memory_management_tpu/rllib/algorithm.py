@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional, Sequence, Type
 
 import numpy as np
 
+from .. import api
 from ..tune.trainable import Trainable
 from .env import make_env
 from .models import ac_init, params_from_numpy, params_to_numpy
@@ -65,6 +66,14 @@ class AlgorithmConfig:
         self.extra.update(extra)
         return self
 
+    def connectors(self, specs) -> "AlgorithmConfig":
+        """Env->policy transform pipeline specs, e.g.
+        [("obs_norm", {}), ("frame_stack", {"k": 4})] — see
+        rllib/connectors.py (the reference's connector framework,
+        rllib/connectors/)."""
+        self.extra["connectors"] = list(specs)
+        return self
+
     def debugging(self, *, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
@@ -94,6 +103,11 @@ class Algorithm(Trainable):
     """Common setup: local policy params + remote rollout workers.
     Subclasses implement ``training_step`` returning metrics."""
 
+    # class-level defaults: subclasses with custom setup() (DQN/SAC/BC)
+    # reject the connectors config and never populate these
+    _connector_specs = None
+    _infer_pipeline = None
+
     def setup(self, config: Dict[str, Any]) -> None:
         import jax
 
@@ -101,7 +115,14 @@ class Algorithm(Trainable):
         seed = config.get("seed", 0)
         self.np_rng = np.random.default_rng(seed)
         probe_env = make_env(config["env_spec"], config.get("env_config"))
-        self.obs_dim = probe_env.observation_dim
+        connectors = config.get("connectors")
+        from .connectors import build_pipeline
+
+        self._connector_specs = connectors
+        # the model is sized for the CONNECTOR-TRANSFORMED observation
+        # (e.g. frame stacking widens it; rllib/connectors/ analog)
+        self.obs_dim = build_pipeline(connectors).obs_dim(
+            probe_env.observation_dim)
         self.num_actions = probe_env.num_actions
         self.params = ac_init(
             jax.random.key(seed), self.obs_dim, self.num_actions,
@@ -114,12 +135,35 @@ class Algorithm(Trainable):
             self.workers = WorkerSet(
                 config["env_spec"], config.get("env_config"),
                 config.get("hidden", (64, 64)),
-                config["num_rollout_workers"], seed, gamma, lam)
+                config["num_rollout_workers"], seed, gamma, lam,
+                connectors=connectors)
         else:
             self.local_worker = RolloutWorker(
                 config["env_spec"], config.get("env_config"),
-                config.get("hidden", (64, 64)), seed, gamma, lam)
+                config.get("hidden", (64, 64)), seed, gamma, lam,
+                connectors=connectors)
+        # inference pipeline: the local worker's (shared object, stats
+        # always warm) or a learner-side copy synced from worker 0 (see
+        # _sync_connector_state) — compute_single_action must see the
+        # SAME transform the policy trained with
+        if self.local_worker is not None:
+            self._infer_pipeline = self.local_worker.connectors
+        else:
+            self._infer_pipeline = build_pipeline(connectors)
         self._timesteps_total = 0
+
+    def _sync_connector_state(self) -> None:
+        """Pull connector state (e.g. running obs-norm stats) from worker
+        0 into the learner's inference pipeline. No-op without connectors
+        or with a shared local worker."""
+        if not self._connector_specs or self.workers is None:
+            return
+        try:
+            state = api.get(self.workers.remote_workers[0]
+                            .get_connector_state.remote())
+            self._infer_pipeline.set_state(state)
+        except Exception:  # noqa: BLE001 — eval freshness is best-effort
+            pass
 
     # -- subclass hook ---------------------------------------------------------
     def training_step(self) -> Dict[str, Any]:
@@ -129,6 +173,7 @@ class Algorithm(Trainable):
         result = self.training_step()
         result.setdefault("timesteps_total", self._timesteps_total)
         result.update(self._episode_metrics())
+        self._sync_connector_state()  # keep eval/checkpoints warm
         return result
 
     def _episode_metrics(self) -> Dict[str, Any]:
@@ -156,22 +201,33 @@ class Algorithm(Trainable):
 
     def compute_single_action(self, obs: np.ndarray) -> int:
         """Greedy action for inference/eval (Algorithm.compute_single_action
-        in the reference)."""
+        in the reference). Observations pass through the connector
+        pipeline WITHOUT updating its statistics — the policy trained on
+        transformed observations and must see the same transform here."""
         from .models import ac_apply
 
         import jax.numpy as jnp
 
+        if self._connector_specs:
+            obs = self._infer_pipeline.observe(
+                np.asarray(obs), update=False)
         logits, _ = ac_apply(self.params, jnp.asarray(obs)[None, :])
         return int(np.argmax(np.asarray(logits)[0]))
 
     # -- checkpointing ---------------------------------------------------------
     def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = {
+            "weights": self.get_weights(),
+            "timesteps_total": self._timesteps_total,
+            "extra": self._save_extra_state(),
+        }
+        if getattr(self, "_connector_specs", None):
+            # connector statistics (e.g. running obs-norm) travel with
+            # the weights: restored policies must see the SAME transform
+            self._sync_connector_state()
+            state["connectors"] = self._infer_pipeline.state()
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
-            pickle.dump({
-                "weights": self.get_weights(),
-                "timesteps_total": self._timesteps_total,
-                "extra": self._save_extra_state(),
-            }, f)
+            pickle.dump(state, f)
 
     def load_checkpoint(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
@@ -179,6 +235,12 @@ class Algorithm(Trainable):
         self.set_weights(state["weights"])
         self._timesteps_total = state["timesteps_total"]
         self._load_extra_state(state.get("extra"))
+        if state.get("connectors") is not None \
+                and getattr(self, "_connector_specs", None):
+            self._infer_pipeline.set_state(state["connectors"])
+            if self.workers is not None:
+                self.workers.set_connector_state(state["connectors"])
+            # local mode: _infer_pipeline IS the worker's pipeline
         self._sync_weights()
 
     def _save_extra_state(self) -> Any:

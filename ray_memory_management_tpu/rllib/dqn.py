@@ -186,6 +186,11 @@ class DQN(Algorithm):
         # workers; DQN needs a Q-net and epsilon-greedy transition
         # collectors, so it wires its own (same env/seed plumbing).
         self.cfg = config
+        if config.get("connectors"):
+            raise ValueError(
+                "connectors are not supported by this algorithm's "
+                "custom rollout collectors yet; use PPO/IMPALA or "
+                "drop the connectors config")
         seed = config.get("seed", 0)
         self.np_rng = np.random.default_rng(seed)
         probe_env = make_env(config["env_spec"], config.get("env_config"))

@@ -22,10 +22,11 @@ from .models import ac_init, params_from_numpy, params_to_numpy, sample_actions
 class RolloutWorker:
     def __init__(self, env_spec, env_config: Optional[dict],
                  hidden, seed: int, gamma: float = 0.99,
-                 lam: float = 0.95):
+                 lam: float = 0.95, connectors=None):
         import jax
 
         from .. import _worker_context
+        from .connectors import build_pipeline
 
         # Rollouts never touch the TPU — but only pin the process-global
         # default device when this IS a dedicated worker process; in
@@ -36,12 +37,16 @@ class RolloutWorker:
         self.env = make_env(env_spec, env_config)
         self.gamma = gamma
         self.lam = lam
+        # env -> policy transform pipeline (rllib/connectors/ analog);
+        # the model is sized for the TRANSFORMED observation
+        self.connectors = build_pipeline(connectors)
+        self.obs_dim = self.connectors.obs_dim(self.env.observation_dim)
         self.rng = np.random.default_rng(seed)
         self._jax_key = jax.random.key(seed)
         self.params = ac_init(
-            jax.random.key(0), self.env.observation_dim,
+            jax.random.key(0), self.obs_dim,
             self.env.num_actions, hidden)
-        self._obs = self.env.reset(seed=seed)
+        self._obs = self.connectors.on_reset(self.env.reset(seed=seed))
         self._episode_reward = 0.0
         self._episode_len = 0
         self.episode_rewards: List[float] = []
@@ -61,8 +66,7 @@ class RolloutWorker:
         rollout_fragment_length contract; sampler.py SyncSampler)."""
         import jax
 
-        obs_buf = np.zeros(
-            (num_steps, self.env.observation_dim), dtype=np.float32)
+        obs_buf = np.zeros((num_steps, self.obs_dim), dtype=np.float32)
         act_buf = np.zeros(num_steps, dtype=np.int32)
         rew_buf = np.zeros(num_steps, dtype=np.float32)
         done_buf = np.zeros(num_steps, dtype=np.float32)
@@ -79,9 +83,10 @@ class RolloutWorker:
             logp_buf[t] = float(logp[0])
             val_buf[t] = float(value[0])
             next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            rew_buf[t] = reward
+            next_obs = self.connectors.observe(next_obs)
+            rew_buf[t] = self.connectors.reward(reward)
             done_buf[t] = float(terminated)
-            self._episode_reward += reward
+            self._episode_reward += reward  # metrics report RAW reward
             self._episode_len += 1
             if truncated and not terminated:
                 # time-limit truncation is not a true terminal: fold the
@@ -98,8 +103,8 @@ class RolloutWorker:
                 self.episode_lengths.append(self._episode_len)
                 self._episode_reward = 0.0
                 self._episode_len = 0
-                next_obs = self.env.reset(
-                    seed=int(self.rng.integers(1 << 31)))
+                next_obs = self.connectors.on_reset(self.env.reset(
+                    seed=int(self.rng.integers(1 << 31))))
             self._obs = next_obs
 
         # bootstrap value for a fragment ending mid-episode
@@ -115,6 +120,12 @@ class RolloutWorker:
             sb.ADVANTAGES: adv, sb.TARGETS: targets,
             sb.BOOTSTRAP: np.array([bootstrap], dtype=np.float32),
         }
+
+    def get_connector_state(self):
+        return self.connectors.state()
+
+    def set_connector_state(self, state) -> None:
+        self.connectors.set_state(state)
 
     def episode_stats(self, window: int = 100) -> Dict[str, Any]:
         rewards = self.episode_rewards[-window:]
@@ -132,12 +143,13 @@ class WorkerSet:
     (worker_set.py:50)."""
 
     def __init__(self, env_spec, env_config, hidden, num_workers: int,
-                 seed: int, gamma: float = 0.99, lam: float = 0.95):
+                 seed: int, gamma: float = 0.99, lam: float = 0.95,
+                 connectors=None):
         cls = api.remote(RolloutWorker)
         self.remote_workers = [
             cls.options(num_cpus=1).remote(
                 env_spec, env_config, hidden, seed + 1000 * (i + 1),
-                gamma, lam)
+                gamma, lam, connectors)
             for i in range(num_workers)
         ]
         api.get([w.ready.remote() for w in self.remote_workers])
@@ -149,6 +161,10 @@ class WorkerSet:
 
     def sample(self, num_steps: int) -> List:
         return [w.sample.remote(num_steps) for w in self.remote_workers]
+
+    def set_connector_state(self, state) -> None:
+        api.get([w.set_connector_state.remote(state)
+                 for w in self.remote_workers])
 
     def stats(self) -> List[Dict[str, Any]]:
         return api.get(

@@ -258,6 +258,11 @@ class SAC(Algorithm):
         import optax
 
         self.cfg = config
+        if config.get("connectors"):
+            raise ValueError(
+                "connectors are not supported by this algorithm's "
+                "custom rollout collectors yet; use PPO/IMPALA or "
+                "drop the connectors config")
         seed = config.get("seed", 0)
         probe_env = make_env(config["env_spec"], config.get("env_config"))
         self.obs_dim = probe_env.observation_dim
