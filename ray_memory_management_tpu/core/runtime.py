@@ -91,9 +91,18 @@ class _SenderPool:
         self._max = max_threads
         self._threads = 0
         self._idle = 0
+        self._stopping = False
         with self._cond:
             for _ in range(base_threads):
                 self._spawn_locked()
+
+    def stop(self) -> None:
+        """Retire every pool thread (runtime shutdown). Without this a
+        test suite creating hundreds of runtimes accumulates hundreds of
+        parked daemon threads for the process lifetime."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
 
     def _spawn_locked(self) -> None:
         self._threads += 1
@@ -128,6 +137,10 @@ class _SenderPool:
             with self._cond:
                 self._idle += 1
                 while not self._ready:
+                    if self._stopping:
+                        self._idle -= 1
+                        self._threads -= 1
+                        return
                     if not self._cond.wait(timeout=10.0):
                         if self._threads > self._base:
                             # surplus grow-thread with nothing to do
@@ -1787,10 +1800,16 @@ class Runtime:
                     remaining[0] -= 1
                     if remaining[0]:
                         return
+                if self._stop.is_set():
+                    return  # shutdown's future fail-pass fired us: do not
+                    # resubmit dispatch work into a tearing-down pool
                 # dep errors are ignored here on purpose: the send path
                 # re-checks availability and runs recovery / fails the task
-                self._request_pool.submit(
-                    self._ensure_actor_args_then_send, info, spec)
+                try:
+                    self._request_pool.submit(
+                        self._ensure_actor_args_then_send, info, spec)
+                except RuntimeError:
+                    pass  # pool already shut down
 
             for fut in missing:
                 fut.add_done_callback(on_dep_done)
@@ -1799,6 +1818,8 @@ class Runtime:
 
     def _ensure_actor_args_then_send(self, info: _ActorInfo,
                                      spec: TaskSpec) -> None:
+        if self._stop.is_set():
+            return  # tearing down: no materialize/recovery round trips
         handle = info.handle
         if handle is None or not handle.alive():
             with self._lock:
@@ -2803,6 +2824,7 @@ class Runtime:
             self.gcs.set_job_state(self.job_id.binary(), "FINISHED")
         except Exception:  # noqa: BLE001
             pass
+        self._sender_pool.stop()
         self._wakeup()
         with self._send_cond:
             channels = list(self._send_channels.values())
@@ -2831,6 +2853,27 @@ class Runtime:
         self._hb.join(timeout=2.0)
         self._request_pool.shutdown(wait=False, cancel_futures=True)
         self._transfer_pool.shutdown(wait=False, cancel_futures=True)
+        # fail every unresolved object future: a pool thread parked in
+        # fut.result() with no timeout (a worker's blocking get) would
+        # otherwise never wake — and concurrent.futures' atexit hook joins
+        # every worker thread ever created, so one sleeper wedges
+        # interpreter exit after the last test finishes. Runs AFTER the
+        # router/pools stop and LOOPS: a woken pool thread can still
+        # insert one more future before it observes _stop (dep callbacks
+        # are _stop-guarded, so nothing resubmits work).
+        for _ in range(20):
+            with self._lock:
+                pending_futs = [f for f in self.futures.values()
+                                if not f.done()]
+            if not pending_futs:
+                break
+            for f in pending_futs:
+                try:
+                    f.set_exception(RuntimeError("runtime shut down"))
+                except Exception:  # noqa: BLE001
+                    pass
+            _SlimFuture.broadcast()
+            time.sleep(0.05)
         for srv in self._xfer_servers.values():
             try:
                 srv.close()
