@@ -12,6 +12,8 @@ Routes::
     /api/cluster            resources total/available
     /api/nodes|actors|tasks|objects|workers|placement_groups
     /api/jobs               job-submission table
+    /api/drivers            GCS job table (driver + client jobs)
+    /api/events             structured cluster events
     /metrics                Prometheus exposition text
 """
 
@@ -114,6 +116,11 @@ class Dashboard:
             from .job_submission import JobSubmissionClient
 
             data = JobSubmissionClient().list_jobs()
+        elif path == "/api/drivers":
+            # the GCS job table: the in-process driver + every thin-client
+            # connection (gcs_job_manager.h:28), distinct from the
+            # submission-queue jobs above
+            data = state.list_jobs()
         elif path == "/api/events":
             from .utils import events as _events
 

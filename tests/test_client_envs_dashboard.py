@@ -209,6 +209,10 @@ class TestDashboard:
             assert b"rmt cluster" in body
             status, body = fetch("/metrics")
             assert status == 200
+            status, body = fetch("/api/drivers")
+            rows = json.loads(body)
+            assert status == 200 and rows and \
+                rows[0]["state"] == "RUNNING"
             status, _ = fetch("/api/bogus")
             assert status == 404
         finally:
