@@ -8,6 +8,7 @@ search spaces tune/search/sample.py, schedulers tune/schedulers/).
 from .search import (
     BasicVariantGenerator,
     RandomSearch,
+    TPESearch,
     Searcher,
     choice,
     grid_search,
@@ -31,7 +32,7 @@ __all__ = [
     "Trainable", "FunctionTrainable", "wrap_function",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "PopulationBasedTraining",
-    "Searcher", "RandomSearch", "BasicVariantGenerator",
+    "Searcher", "RandomSearch", "TPESearch", "BasicVariantGenerator",
     "uniform", "quniform", "loguniform", "randint", "choice",
     "grid_search", "sample_from",
 ]

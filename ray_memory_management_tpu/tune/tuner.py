@@ -340,21 +340,60 @@ class Tuner:
         raise TypeError(f"unsupported trainable: {t!r}")
 
     def _generate_trials(self) -> List[Trial]:
-        configs: List[Dict[str, Any]]
-        if self.cfg.search_alg is not None:
-            configs = [self.cfg.search_alg.suggest(f"t{i}")
-                       for i in range(self.cfg.num_samples)]
-        else:
-            configs = BasicVariantGenerator(
-                self.param_space, self.cfg.num_samples,
-                seed=self.cfg.seed).variants()
+        configs = BasicVariantGenerator(
+            self.param_space, self.cfg.num_samples,
+            seed=self.cfg.seed).variants()
         return [Trial(c, i, self.name) for i, c in enumerate(configs)]
 
+    def _fit_with_searcher(self) -> List[Trial]:
+        """Model-based search needs results fed back between suggestions
+        (the suggest/on_trial_complete loop, tune/search/searcher.py):
+        trials run in waves of ``max_concurrent_trials`` (default 1 wave
+        of everything for a stateless searcher would starve the model, so
+        the default wave is 1), with every completion reported to the
+        searcher before the next wave is suggested."""
+        import dataclasses
+
+        alg = self.cfg.search_alg
+        # wave size trades model freshness for throughput: 1 gives the
+        # searcher feedback after every trial, large waves parallelize.
+        # Default 4 keeps feedback-free searchers from running strictly
+        # serially while a model-based searcher still observes often.
+        wave = self.cfg.max_concurrent_trials or 4
+        # the runner must NOT also report to the searcher (it would use
+        # its own trial ids, double-counting every completion); this loop
+        # is the single feedback path, keyed by the suggest() ids
+        runner_cfg = dataclasses.replace(self.cfg, search_alg=None)
+        payload = self._trainable_payload()  # pickle the trainable once
+        trials: List[Trial] = []
+        i = 0
+        while i < self.cfg.num_samples:
+            batch = []
+            for j in range(min(wave, self.cfg.num_samples - i)):
+                cfg = alg.suggest(f"t{i + j}")
+                if cfg is None:
+                    break  # searcher exhausted
+                batch.append(Trial(cfg, i + j, self.name))
+            if not batch:
+                break
+            runner = TrialRunner(payload, batch, runner_cfg,
+                                 self.resources)
+            runner.run()
+            for j, t in enumerate(batch):
+                alg.on_trial_complete(f"t{i + j}", t.last_result,
+                                      error=t.error is not None)
+            trials.extend(batch)
+            i += len(batch)
+        return trials
+
     def fit(self) -> ResultGrid:
-        trials = self._generate_trials()
-        runner = TrialRunner(self._trainable_payload(), trials, self.cfg,
-                             self.resources)
-        runner.run()
+        if self.cfg.search_alg is not None:
+            trials = self._fit_with_searcher()
+        else:
+            trials = self._generate_trials()
+            runner = TrialRunner(self._trainable_payload(), trials,
+                                 self.cfg, self.resources)
+            runner.run()
         results = [
             TrialResult(
                 trial_id=t.id, config=t.config, metrics=t.last_result,
