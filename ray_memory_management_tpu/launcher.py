@@ -161,11 +161,24 @@ class GCETPUProvider(NodeProvider):
     ``accelerator_type`` (e.g. v5litepod-8), ``version`` (runtime image),
     plus the usual num_cpus/num_tpus. Provider config: ``project``,
     ``zone``, optional ``gcloud_command`` (tests substitute a recording
-    shim), ``remote_python``, and ``bootstrap`` (shell prefix run before
-    the agent, e.g. a pip install of this package). For multi-host pods
+    shim), ``remote_python``, ``bootstrap`` (shell prefix run before
+    the agent, e.g. a pip install of this package), ``create_retries``
+    (default 3) and ``create_retry_wait_s`` (default 30, doubled per
+    attempt) for transient create failures. For multi-host pods
     the agent starts on EVERY host (``--worker=all``) — each host joins
     the head as its own node, which is exactly the one-agent-per-host
     model the multi-host plane expects."""
+
+    # stderr markers of transient gcloud failures worth retrying: capacity
+    # stockouts, quota/rate limiting, and service-side flakiness. Anything
+    # else (auth, bad flags, permission) fails fast into record["error"].
+    # Phrases, not bare substrings: operation ids / request URLs embed
+    # arbitrary digits, so bare "429"/"503" would misclassify permanent
+    # errors (HTTP codes are matched word-bounded in _retryable).
+    _RETRYABLE = ("RESOURCE_EXHAUSTED", "ZONE_RESOURCE_POOL_EXHAUSTED",
+                  "QUOTA EXCEEDED", "QUOTA_EXCEEDED", "RATE_LIMIT",
+                  "RATE LIMIT", "UNAVAILABLE", "INTERNAL ERROR",
+                  "DEADLINE_EXCEEDED", "TRY AGAIN")
 
     def __init__(self, provider_cfg: Dict[str, Any], log_dir: str = ""):
         import itertools
@@ -175,8 +188,20 @@ class GCETPUProvider(NodeProvider):
         self.zone = provider_cfg.get("zone", "")
         self.python = provider_cfg.get("remote_python", "python3")
         self.bootstrap = provider_cfg.get("bootstrap", "")
+        self.create_retries = int(provider_cfg.get("create_retries", 3))
+        self.create_retry_wait_s = float(
+            provider_cfg.get("create_retry_wait_s", 30.0))
         self.log_dir = log_dir
         self._counter = itertools.count(1)  # thread-safe (CPython atomic)
+
+    @classmethod
+    def _retryable(cls, stderr: str) -> bool:
+        import re
+
+        up = stderr.upper()
+        if any(marker in up for marker in cls._RETRYABLE):
+            return True
+        return re.search(r"\b(429|503)\b", up) is not None
 
     def _scope(self) -> List[str]:
         out = []
@@ -221,11 +246,43 @@ class GCETPUProvider(NodeProvider):
         def provision():
             # create takes MINUTES per TPU VM: run it off the caller so a
             # multi-worker `up` provisions the whole pod concurrently
-            # (nodes join the head as their agents come up)
-            rc = subprocess.run(create, capture_output=True, text=True,
-                                timeout=1800)
-            if rc.returncode != 0:
-                record["error"] = rc.stderr.strip()[-500:]
+            # (nodes join the head as their agents come up). Transient
+            # failures — capacity stockouts, quota/rate limits, service
+            # flakiness, hung creates — retry with exponential backoff;
+            # everything else fails fast into record["error"].
+            for attempt in range(self.create_retries + 1):
+                with record["_mu"]:
+                    if record["cancelled"]:
+                        return  # terminated before we created anything
+                try:
+                    rc = subprocess.run(create, capture_output=True,
+                                        text=True, timeout=1800)
+                except subprocess.TimeoutExpired:
+                    # a hung create is the same transient condition as a
+                    # server-reported timeout: retry it
+                    if attempt < self.create_retries:
+                        time.sleep(
+                            self.create_retry_wait_s * (2 ** attempt))
+                        continue
+                    record["error"] = "create timed out after retries"
+                    return
+                except Exception as e:  # noqa: BLE001
+                    record["error"] = f"create failed: {e!r}"
+                    return
+                if rc.returncode == 0:
+                    break
+                err = rc.stderr.strip()
+                if attempt > 0 and "ALREADY_EXISTS" in err.upper():
+                    # an earlier "failed" attempt actually landed server-
+                    # side (the classic ambiguous 503-after-accept): the
+                    # VM exists, so proceed to ssh — failing here would
+                    # leave a billed VM running that nothing tracks or
+                    # deletes
+                    break
+                if attempt < self.create_retries and self._retryable(err):
+                    time.sleep(self.create_retry_wait_s * (2 ** attempt))
+                    continue
+                record["error"] = err[-500:]
                 return
             with record["_mu"]:
                 cancelled = record["cancelled"]

@@ -148,3 +148,67 @@ def test_gce_tpu_provider_command_shape(tmp_path, monkeypatch):
         time.sleep(0.05)
     assert any("delete podnode" in ln and "--quiet" in ln
                for ln in log.read_text().splitlines())
+
+
+def test_gce_tpu_create_retries_transient_failures(tmp_path):
+    """A capacity stockout on create retries with backoff and succeeds;
+    a non-transient error fails fast into record['error']."""
+    import time as _time
+
+    count_file = tmp_path / "count"
+    count_file.write_text("0")
+    log = tmp_path / "gcloud.log"
+    shim = tmp_path / "gcloud"
+    shim.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+case "$*" in
+  *create*)
+    n=$(cat {count_file})
+    echo $((n + 1)) > {count_file}
+    if [ "$n" -lt 2 ]; then
+      echo "ERROR: ZONE_RESOURCE_POOL_EXHAUSTED: no capacity" >&2
+      exit 1
+    fi
+    ;;
+esac
+exit 0
+""")
+    shim.chmod(0o755)
+    from ray_memory_management_tpu import launcher
+
+    provider = launcher.GCETPUProvider({
+        "type": "gce-tpu", "gcloud_command": str(shim),
+        "project": "p", "zone": "z",
+        "create_retries": 3, "create_retry_wait_s": 0.05,
+    })
+    rec = provider.launch_worker({"name": "stocked"}, "h:1", "ab")
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline:
+        if log.exists() and any(" ssh " in ln
+                                for ln in log.read_text().splitlines()):
+            break
+        _time.sleep(0.05)
+    assert rec["error"] is None
+    assert count_file.read_text().strip() == "3"  # 2 failures + 1 success
+    assert any(" ssh " in ln for ln in log.read_text().splitlines())
+    provider.terminate_worker(rec)
+
+    # non-transient error: no retries, error recorded
+    bad_log = tmp_path / "bad.log"
+    bad = tmp_path / "gcloud_bad"
+    bad.write_text(f"""#!/bin/sh
+echo "$@" >> {bad_log}
+case "$*" in *create*) echo "ERROR: PERMISSION_DENIED" >&2; exit 1;; esac
+exit 0
+""")
+    bad.chmod(0o755)
+    provider2 = launcher.GCETPUProvider({
+        "type": "gce-tpu", "gcloud_command": str(bad),
+        "create_retries": 3, "create_retry_wait_s": 0.05,
+    })
+    rec2 = provider2.launch_worker({"name": "denied"}, "h:1", "ab")
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline and rec2["error"] is None:
+        _time.sleep(0.05)
+    assert rec2["error"] and "PERMISSION_DENIED" in rec2["error"]
+    assert bad_log.read_text().count("create") == 1  # no retry
