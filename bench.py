@@ -82,15 +82,34 @@ def _tpu_suite():
     out = {}
     train_rows = [
         # (tag, kwargs): the flagship row plus the long-context and the
-        # ~1B-param rows (VERDICT r2: bench the bigger model and S=4096)
-        ("gpt2-small S=1024", {}),
-        ("gpt2-small S=4096", {"seq_len": 4096, "batch_size": 2}),
+        # ~1B-param rows (VERDICT r2: bench the bigger model and S=4096).
+        # Batch sizes are the measured single-chip sweet spots (B=16 at
+        # S=1024 peaks MFU; B=32 regresses on activation HBM traffic).
+        ("gpt2-small S=1024", {"batch_size": 16}),
+        ("gpt2-small S=1024 bf16", {"batch_size": 16,
+                                    "bf16_params": True}),
+        ("gpt2-small S=4096", {"seq_len": 4096, "batch_size": 4}),
         ("llama-1b S=2048", {"preset": "llama-1b", "seq_len": 2048,
                              "batch_size": 4, "bf16_params": True}),
     ]
+
+    def _retry(fn, *a, **kw):
+        # the tunneled runtime can drop a long remote_compile mid-flight
+        # ("response body closed before all bytes were read"); one retry
+        # after a pause recovers it, a hard failure re-raises
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # pragma: no cover - hardware variance
+            print(f"  tpu row transient failure, retrying in 20s: {e!r}",
+                  file=sys.stderr)
+            import time as _t
+
+            _t.sleep(20)
+            return fn(*a, **kw)
+
     for tag, kw in train_rows:
         try:
-            mfu = tpu_bench.train_step_mfu(**kw)
+            mfu = _retry(tpu_bench.train_step_mfu, **kw)
             print(
                 f"  tpu train {tag}: {mfu['tokens_per_s']:,.0f} tok/s"
                 f"  MFU {mfu['mfu']:.3f}  step {mfu['step_ms']:.1f} ms"
@@ -105,7 +124,7 @@ def _tpu_suite():
         except Exception as e:  # pragma: no cover - hardware variance
             print(f"  tpu train bench {tag} failed: {e!r}", file=sys.stderr)
     try:
-        fa = tpu_bench.flash_attention_bench()
+        fa = _retry(tpu_bench.flash_attention_bench)
         for S, d in fa.items():
             print(
                 f"  tpu flash-attn S={S}: {d['flash_ms']:.2f} ms vs ref "
@@ -116,7 +135,7 @@ def _tpu_suite():
     except Exception as e:  # pragma: no cover
         print(f"  tpu flash bench failed: {e!r}", file=sys.stderr)
     try:
-        sv = tpu_bench.llm_serving_bench()
+        sv = _retry(tpu_bench.llm_serving_bench)
         ratio = sv.get("continuous_vs_barrier")
         print(
             f"  tpu serve-LM decode: {sv['decode_tokens_per_s']:,.0f} tok/s"
@@ -131,7 +150,7 @@ def _tpu_suite():
     except Exception as e:  # pragma: no cover
         print(f"  tpu serve bench failed: {e!r}", file=sys.stderr)
     try:
-        bw = tpu_bench.allreduce_busbw()
+        bw = _retry(tpu_bench.allreduce_busbw)
         if bw is None:
             print("  tpu allreduce bus-bw: skipped (single chip attached)",
                   file=sys.stderr)

@@ -71,8 +71,31 @@ def load_state(name: str) -> Optional[Dict[str, Any]]:
 
 
 def save_state(name: str, state: Dict[str, Any]) -> None:
+    """Persist cluster state. Worker records are live objects that
+    provider threads mutate and may carry runtime-only fields (the GCE
+    provider's "_mu" lock, which json.dump would crash on): persist a
+    snapshot of the JSON-safe public fields only — ``down`` needs
+    name/kind/pid, and terminate_worker treats a missing "_mu" as
+    "loaded from disk"."""
+
+    def _public(rec: Dict[str, Any]) -> Dict[str, Any]:
+        mu = rec.get("_mu")
+        if mu is not None:
+            with mu:
+                items = list(rec.items())
+        else:
+            items = list(rec.items())
+        return {k: v for k, v in items
+                if not k.startswith("_")
+                and isinstance(v, (str, int, float, bool, type(None)))}
+
+    snapshot = dict(state)
+    if isinstance(snapshot.get("workers"), list):
+        snapshot["workers"] = [
+            _public(w) if isinstance(w, dict) else w
+            for w in snapshot["workers"]]
     with open(_state_path(name), "w") as f:
-        json.dump(state, f, indent=2)
+        json.dump(snapshot, f, indent=2)
 
 
 # ---------------------------------------------------------------- providers
@@ -211,6 +234,62 @@ class GCETPUProvider(NodeProvider):
             out += ["--zone", self.zone]
         return out
 
+    def _wait_ready(self, name: str, record,
+                    timeout_s: float = 900.0) -> str:
+        """Poll ``describe`` until the TPU VM reports READY (used when a
+        create was adopted via ALREADY_EXISTS and the server-side
+        operation may still be provisioning). Returns "ready",
+        "cancelled" (terminate_worker ran — the caller must fall through
+        to its cancelled-cleanup delete, not bail out before it), or
+        "failed" (record["error"] set)."""
+        deadline = time.monotonic() + timeout_s
+        describe = [self.gcloud, "compute", "tpus", "tpu-vm", "describe",
+                    name, *self._scope(), "--format", "value(state)"]
+        consecutive_failures = 0
+        while time.monotonic() < deadline:
+            with record["_mu"]:
+                if record["cancelled"]:
+                    return "cancelled"
+            try:
+                rc = subprocess.run(describe, capture_output=True,
+                                    text=True, timeout=120)
+            except Exception:  # noqa: BLE001 - transient describe flake
+                consecutive_failures += 1
+                if consecutive_failures >= 6:
+                    record["error"] = (f"describe {name} kept "
+                                       "failing/hanging")
+                    return "failed"
+                time.sleep(10)
+                continue
+            if rc.returncode != 0:
+                err = rc.stderr.strip()
+                up = err.upper()
+                # a gone VM or dead credentials will never turn READY:
+                # fail fast instead of burning the full timeout
+                if "NOT_FOUND" in up or "PERMISSION" in up or \
+                        "UNAUTHENTICATED" in up:
+                    record["error"] = (f"describe {name} failed: "
+                                       + err[-400:])
+                    return "failed"
+                consecutive_failures += 1
+                if consecutive_failures >= 6:
+                    record["error"] = (f"describe {name} kept failing: "
+                                       + err[-400:])
+                    return "failed"
+                time.sleep(10)
+                continue
+            consecutive_failures = 0
+            state = rc.stdout.strip().upper()
+            if state == "READY":
+                return "ready"
+            if state in ("TERMINATED", "PREEMPTED", "DELETING"):
+                record["error"] = f"vm {name} entered state {state}"
+                return "failed"
+            time.sleep(10)
+        record["error"] = (f"vm {name} not READY after {timeout_s:.0f}s "
+                           "(adopted via ALREADY_EXISTS)")
+        return "failed"
+
     def launch_worker(self, spec, head_addr, authkey_hex):
         import threading
 
@@ -277,7 +356,18 @@ class GCETPUProvider(NodeProvider):
                     # side (the classic ambiguous 503-after-accept): the
                     # VM exists, so proceed to ssh — failing here would
                     # leave a billed VM running that nothing tracks or
-                    # deletes
+                    # deletes. The server-side create may still be
+                    # mid-provision (the timed-out attempt's operation
+                    # keeps running), and ssh is one-shot: wait for READY
+                    # first or the agent launch fails with no retry.
+                    status = self._wait_ready(name, record)
+                    if status == "failed":
+                        # error recorded; the VM stays in cluster state so
+                        # `rmt down` still deletes it
+                        return
+                    # "ready" falls through to ssh; "cancelled" falls
+                    # through to the post-loop cancelled check, which
+                    # skips ssh and runs the cleanup delete
                     break
                 if attempt < self.create_retries and self._retryable(err):
                     time.sleep(self.create_retry_wait_s * (2 ** attempt))

@@ -35,4 +35,5 @@ from .offline import (  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
+from .td3 import TD3, DDPGConfig, TD3Config  # noqa: F401
 from .rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

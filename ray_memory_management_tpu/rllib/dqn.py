@@ -20,12 +20,11 @@ import numpy as np
 from .. import api
 from . import sample_batch as sb
 from .algorithm import Algorithm, AlgorithmConfig
+from .collector import NEXT_OBS, OffPolicyCollector
 from .env import make_env
 from .models import mlp_apply, mlp_init, params_from_numpy, params_to_numpy
 from .replay import ReplayBuffer
 from .rollout_worker import WorkerSet
-
-NEXT_OBS = "next_obs"
 
 
 def q_init(rng, obs_dim: int, num_actions: int, hidden=(64, 64)):
@@ -74,7 +73,7 @@ def make_dqn_update(optimizer, gamma: float):
     return update
 
 
-class DQNRolloutWorker:
+class DQNRolloutWorker(OffPolicyCollector):
     """Epsilon-greedy transition collector (the exploration half of the
     reference's EpsilonGreedy rllib/utils/exploration/epsilon_greedy.py:26,
     with the worker loop of rollout_worker.py:124). Emits raw
@@ -85,76 +84,29 @@ class DQNRolloutWorker:
                  seed: int):
         import jax
 
-        from .. import _worker_context
-
-        if _worker_context.in_worker():
-            jax.config.update("jax_default_device", jax.devices("cpu")[0])
-        self.env = make_env(env_spec, env_config)
-        self.rng = np.random.default_rng(seed)
+        self._setup_env(env_spec, env_config, seed)
         self.params = q_init(
             jax.random.key(0), self.env.observation_dim,
             self.env.num_actions, hidden)
-        self._obs = self.env.reset(seed=seed)
-        self._episode_reward = 0.0
-        self._episode_len = 0
-        self.episode_rewards: List[float] = []
-        self.episode_lengths: List[int] = []
-
-    def ready(self) -> str:
-        return "ok"
+        self._epsilon = 1.0
 
     def set_weights(self, weights) -> None:
         self.params = params_from_numpy(weights)
 
     def sample(self, num_steps: int, epsilon: float) -> Dict[str, np.ndarray]:
+        self._epsilon = epsilon
+        return self._collect(num_steps)
+
+    def _action_buffer(self, num_steps: int) -> np.ndarray:
+        return np.zeros(num_steps, np.int32)
+
+    def _select_action(self) -> int:
         import jax.numpy as jnp
 
-        D = self.env.observation_dim
-        obs_buf = np.zeros((num_steps, D), np.float32)
-        next_buf = np.zeros((num_steps, D), np.float32)
-        act_buf = np.zeros(num_steps, np.int32)
-        rew_buf = np.zeros(num_steps, np.float32)
-        done_buf = np.zeros(num_steps, np.float32)
-        for t in range(num_steps):
-            if self.rng.random() < epsilon:
-                a = int(self.rng.integers(self.env.num_actions))
-            else:
-                q = q_apply(self.params, jnp.asarray(self._obs[None, :]))
-                a = int(np.asarray(q)[0].argmax())
-            next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            obs_buf[t] = self._obs
-            act_buf[t] = a
-            rew_buf[t] = reward
-            # a time-limit truncation is NOT a terminal: the TD target
-            # must still bootstrap from next_obs (postprocessing.py
-            # treats truncations the same way)
-            done_buf[t] = float(terminated)
-            next_buf[t] = next_obs
-            self._episode_reward += reward
-            self._episode_len += 1
-            if terminated or truncated:
-                self.episode_rewards.append(self._episode_reward)
-                self.episode_lengths.append(self._episode_len)
-                self._episode_reward = 0.0
-                self._episode_len = 0
-                next_obs = self.env.reset(
-                    seed=int(self.rng.integers(1 << 31)))
-            self._obs = next_obs
-        return {
-            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
-            NEXT_OBS: next_buf, sb.DONES: done_buf,
-        }
-
-    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
-        rewards = self.episode_rewards[-window:]
-        lengths = self.episode_lengths[-window:]
-        return {
-            "episodes": len(self.episode_rewards),
-            "episode_reward_mean": float(np.mean(rewards)) if rewards
-            else None,
-            "episode_len_mean": float(np.mean(lengths)) if lengths
-            else None,
-        }
+        if self.rng.random() < self._epsilon:
+            return int(self.rng.integers(self.env.num_actions))
+        q = q_apply(self.params, jnp.asarray(self._obs[None, :]))
+        return int(np.asarray(q)[0].argmax())
 
 
 class _DQNWorkerSet(WorkerSet):

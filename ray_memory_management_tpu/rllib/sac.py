@@ -20,7 +20,7 @@ import numpy as np
 from .. import api
 from . import sample_batch as sb
 from .algorithm import Algorithm, AlgorithmConfig
-from .dqn import NEXT_OBS
+from .collector import NEXT_OBS, OffPolicyCollector
 from .env import make_env
 from .models import mlp_apply, mlp_init, params_from_numpy, params_to_numpy
 from .replay import ReplayBuffer
@@ -143,7 +143,7 @@ def make_sac_update(pi_opt, q_opt, a_opt, gamma: float, tau: float,
     return update
 
 
-class SACRolloutWorker:
+class SACRolloutWorker(OffPolicyCollector):
     """Stochastic-policy transition collector for continuous actions:
     samples from the squashed Gaussian (exploration IS the policy noise);
     the first ``random_steps`` draw uniform actions to seed the replay
@@ -153,26 +153,13 @@ class SACRolloutWorker:
                  seed: int):
         import jax
 
-        from .. import _worker_context
-
-        if _worker_context.in_worker():
-            jax.config.update("jax_default_device", jax.devices("cpu")[0])
-        self.env = make_env(env_spec, env_config)
+        self._setup_env(env_spec, env_config, seed)
         self.bound = float(getattr(self.env, "action_bound", 1.0))
         self.act_dim = int(getattr(self.env, "action_dim", 1))
-        self.rng = np.random.default_rng(seed)
         self.key = jax.random.PRNGKey(seed)
         self.params = sac_init(jax.random.key(0), self.env.observation_dim,
                                self.act_dim, hidden)
-        self._obs = self.env.reset(seed=seed)
-        self._episode_reward = 0.0
-        self._episode_len = 0
-        self.episode_rewards: List[float] = []
-        self.episode_lengths: List[int] = []
-        self._steps_done = 0
-
-    def ready(self) -> str:
-        return "ok"
+        self._random_steps = 0
 
     def set_weights(self, weights) -> None:
         # the learner broadcasts only the pi subtree (all a rollout
@@ -182,57 +169,22 @@ class SACRolloutWorker:
 
     def sample(self, num_steps: int,
                random_steps: int = 0) -> Dict[str, np.ndarray]:
+        self._random_steps = random_steps
+        return self._collect(num_steps)
+
+    def _action_buffer(self, num_steps: int) -> np.ndarray:
+        return np.zeros((num_steps, self.act_dim), np.float32)
+
+    def _select_action(self) -> np.ndarray:
         import jax
         import jax.numpy as jnp
 
-        D, A = self.env.observation_dim, self.act_dim
-        obs_buf = np.zeros((num_steps, D), np.float32)
-        next_buf = np.zeros((num_steps, D), np.float32)
-        act_buf = np.zeros((num_steps, A), np.float32)
-        rew_buf = np.zeros(num_steps, np.float32)
-        done_buf = np.zeros(num_steps, np.float32)
-        for t in range(num_steps):
-            if self._steps_done < random_steps:
-                a = self.rng.uniform(-self.bound, self.bound, A)
-            else:
-                self.key, sub = jax.random.split(self.key)
-                a, _ = pi_sample(self.params,
-                                 jnp.asarray(self._obs[None, :]), sub,
-                                 self.bound)
-                a = np.asarray(a)[0]
-            next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            obs_buf[t] = self._obs
-            act_buf[t] = a
-            rew_buf[t] = reward
-            # truncation is not terminal: the TD target still bootstraps
-            done_buf[t] = float(terminated)
-            next_buf[t] = next_obs
-            self._episode_reward += reward
-            self._episode_len += 1
-            self._steps_done += 1
-            if terminated or truncated:
-                self.episode_rewards.append(self._episode_reward)
-                self.episode_lengths.append(self._episode_len)
-                self._episode_reward = 0.0
-                self._episode_len = 0
-                next_obs = self.env.reset(
-                    seed=int(self.rng.integers(1 << 31)))
-            self._obs = next_obs
-        return {
-            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
-            NEXT_OBS: next_buf, sb.DONES: done_buf,
-        }
-
-    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
-        rewards = self.episode_rewards[-window:]
-        lengths = self.episode_lengths[-window:]
-        return {
-            "episodes": len(self.episode_rewards),
-            "episode_reward_mean": float(np.mean(rewards)) if rewards
-            else None,
-            "episode_len_mean": float(np.mean(lengths)) if lengths
-            else None,
-        }
+        if self._steps_done < self._random_steps:
+            return self.rng.uniform(-self.bound, self.bound, self.act_dim)
+        self.key, sub = jax.random.split(self.key)
+        a, _ = pi_sample(self.params, jnp.asarray(self._obs[None, :]),
+                         sub, self.bound)
+        return np.asarray(a)[0]
 
 
 class _SACWorkerSet(WorkerSet):
