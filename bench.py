@@ -63,23 +63,60 @@ def _tpu_available():
     return False, last
 
 
+def _tpu_row(fn_name: str, kwargs: dict, timeout_s: int = 1500,
+             retries: int = 1):
+    """Run one TPU bench row in a FRESH subprocess with a hard timeout.
+
+    In-process isolation is not enough: when the tunneled TPU backend
+    fails mid-run (UNAVAILABLE / dropped remote_compile), the jax
+    backend in THIS process is poisoned and an in-process retry can hang
+    forever — observed wedging the whole suite for 30+ minutes. A fresh
+    interpreter gets a fresh backend; a hung row costs timeout_s, not
+    the round. Returns (result_dict_or_None, error_or_None)."""
+    import subprocess
+    import time
+
+    code = (
+        "import json\n"
+        "import jax\n"
+        # a fresh interpreter can silently fall back to the CPU backend
+        # (tunnel dropped between probe and row): refuse to record
+        # CPU-fallback numbers as TPU results
+        "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        f"from ray_memory_management_tpu.utils.tpu_bench import {fn_name}\n"
+        f"r = {fn_name}(**{kwargs!r})\n"
+        "print('RMTBENCH ' + json.dumps(r))\n")
+    err = "unknown"
+    for attempt in range(retries + 1):
+        if attempt:
+            print(f"  tpu row {fn_name} failed ({err}); retrying in a "
+                  "fresh process in 20s", file=sys.stderr)
+            time.sleep(20)
+        try:
+            rc = subprocess.run([sys.executable, "-c", code],
+                                capture_output=True, text=True,
+                                timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            err = f"row timed out after {timeout_s}s"
+            continue
+        for line in reversed(rc.stdout.strip().splitlines()):
+            if line.startswith("RMTBENCH "):
+                return json.loads(line[len("RMTBENCH "):]), None
+        err = (f"rc={rc.returncode} "
+               f"stderr={rc.stderr.strip()[-300:]!r}")
+    return None, err
+
+
 def _tpu_suite():
     """TPU compute benchmarks; returns a dict for the JSON line (or None
-    off-TPU). Each sub-benchmark is independently fault-isolated so a
-    regression in one still reports the others."""
+    off-TPU). Every row runs in its own subprocess (see _tpu_row) so a
+    wedged backend or a regression in one row still reports the others."""
     ok, err = _tpu_available()
     if not ok:
         print("  tpu suite skipped: no reachable TPU", file=sys.stderr)
         return {"error": f"no reachable TPU: {err}"}
-    try:
-        from ray_memory_management_tpu.utils import tpu_bench
-
-        if not tpu_bench.on_tpu():
-            return {"error": "jax default backend is not TPU"}
-    except Exception as e:
-        print(f"  tpu suite unavailable: {e!r}", file=sys.stderr)
-        return {"error": f"tpu suite unavailable: {e!r}"}
     out = {}
+    last_err = None
     train_rows = [
         # (tag, kwargs): the flagship row plus the long-context and the
         # ~1B-param rows (VERDICT r2: bench the bigger model and S=4096).
@@ -92,50 +129,41 @@ def _tpu_suite():
         ("llama-1b S=2048", {"preset": "llama-1b", "seq_len": 2048,
                              "batch_size": 4, "bf16_params": True}),
     ]
-
-    def _retry(fn, *a, **kw):
-        # the tunneled runtime can drop a long remote_compile mid-flight
-        # ("response body closed before all bytes were read"); one retry
-        # after a pause recovers it, a hard failure re-raises
-        try:
-            return fn(*a, **kw)
-        except Exception as e:  # pragma: no cover - hardware variance
-            print(f"  tpu row transient failure, retrying in 20s: {e!r}",
-                  file=sys.stderr)
-            import time as _t
-
-            _t.sleep(20)
-            return fn(*a, **kw)
-
     for tag, kw in train_rows:
-        try:
-            mfu = _retry(tpu_bench.train_step_mfu, **kw)
-            print(
-                f"  tpu train {tag}: {mfu['tokens_per_s']:,.0f} tok/s"
-                f"  MFU {mfu['mfu']:.3f}  step {mfu['step_ms']:.1f} ms"
-                f"  ({mfu['n_params']/1e6:.0f}M params)", file=sys.stderr)
-            if tag == "gpt2-small S=1024":
-                out["train_tokens_per_s"] = round(mfu["tokens_per_s"], 1)
-                out["train_mfu"] = round(mfu["mfu"], 4)
-            else:
-                out.setdefault("train_rows", {})[tag] = {
-                    "tokens_per_s": round(mfu["tokens_per_s"], 1),
-                    "mfu": round(mfu["mfu"], 4)}
-        except Exception as e:  # pragma: no cover - hardware variance
-            print(f"  tpu train bench {tag} failed: {e!r}", file=sys.stderr)
-    try:
-        fa = _retry(tpu_bench.flash_attention_bench)
-        for S, d in fa.items():
+        mfu, row_err = _tpu_row("train_step_mfu", kw)
+        if mfu is None:
+            print(f"  tpu train bench {tag} failed: {row_err}",
+                  file=sys.stderr)
+            last_err = row_err
+            continue
+        print(
+            f"  tpu train {tag}: {mfu['tokens_per_s']:,.0f} tok/s"
+            f"  MFU {mfu['mfu']:.3f}  step {mfu['step_ms']:.1f} ms"
+            f"  ({mfu['n_params']/1e6:.0f}M params)", file=sys.stderr)
+        if tag == "gpt2-small S=1024":
+            out["train_tokens_per_s"] = round(mfu["tokens_per_s"], 1)
+            out["train_mfu"] = round(mfu["mfu"], 4)
+        else:
+            out.setdefault("train_rows", {})[tag] = {
+                "tokens_per_s": round(mfu["tokens_per_s"], 1),
+                "mfu": round(mfu["mfu"], 4)}
+    fa, row_err = _tpu_row("flash_attention_bench", {}, timeout_s=1800)
+    if fa is None:
+        print(f"  tpu flash bench failed: {row_err}", file=sys.stderr)
+        last_err = row_err
+    else:
+        for S, d in fa.items():  # JSON round-trip makes keys strings
             print(
                 f"  tpu flash-attn S={S}: {d['flash_ms']:.2f} ms vs ref "
                 f"{d['ref_ms']:.2f} ms -> {d['speedup']:.2f}x",
                 file=sys.stderr)
         out["flash_speedup"] = {
             str(S): round(d["speedup"], 2) for S, d in fa.items()}
-    except Exception as e:  # pragma: no cover
-        print(f"  tpu flash bench failed: {e!r}", file=sys.stderr)
-    try:
-        sv = _retry(tpu_bench.llm_serving_bench)
+    sv, row_err = _tpu_row("llm_serving_bench", {}, timeout_s=2400)
+    if sv is None:
+        print(f"  tpu serve bench failed: {row_err}", file=sys.stderr)
+        last_err = row_err
+    else:
         ratio = sv.get("continuous_vs_barrier")
         print(
             f"  tpu serve-LM decode: {sv['decode_tokens_per_s']:,.0f} tok/s"
@@ -147,21 +175,23 @@ def _tpu_suite():
             sv["decode_tokens_per_s"], 1)
         if ratio:
             out["serve_continuous_vs_barrier"] = round(ratio, 2)
-    except Exception as e:  # pragma: no cover
-        print(f"  tpu serve bench failed: {e!r}", file=sys.stderr)
-    try:
-        bw = _retry(tpu_bench.allreduce_busbw)
-        if bw is None:
-            print("  tpu allreduce bus-bw: skipped (single chip attached)",
-                  file=sys.stderr)
-        else:
-            print(
-                f"  tpu allreduce bus-bw: {bw['busbw_gbps']:.1f} GB/s "
-                f"(world={bw['world']})", file=sys.stderr)
-            out["allreduce_busbw_gbps"] = round(bw["busbw_gbps"], 2)
-    except Exception as e:  # pragma: no cover
-        print(f"  tpu allreduce bench failed: {e!r}", file=sys.stderr)
-    return out or None
+    bw, row_err = _tpu_row("allreduce_busbw", {}, timeout_s=900)
+    if bw is None and row_err is not None:
+        print(f"  tpu allreduce bench failed: {row_err}", file=sys.stderr)
+        last_err = row_err
+    elif bw is None:
+        print("  tpu allreduce bus-bw: skipped (single chip attached)",
+              file=sys.stderr)
+    else:
+        print(
+            f"  tpu allreduce bus-bw: {bw['busbw_gbps']:.1f} GB/s "
+            f"(world={bw['world']})", file=sys.stderr)
+        out["allreduce_busbw_gbps"] = round(bw["busbw_gbps"], 2)
+    if not out:
+        # every row failed (e.g. the tunnel died right after the probe):
+        # keep the failure LOUD in the JSON, not a silent tpu:null
+        return {"error": f"all tpu rows failed; last: {last_err}"}
+    return out
 
 
 def _scale_suite():

@@ -15,7 +15,10 @@ from .connectors import (  # noqa: F401
     ObsNormalizer,
     register_connector,
 )
+from .appo import APPO, APPOConfig  # noqa: F401
+from .cql import CQL, CQLConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
+from .marwil import MARWIL, MARWILConfig  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,
     Env,

@@ -1,0 +1,97 @@
+"""APPO: asynchronous PPO on the IMPALA architecture.
+
+The reference's APPO (rllib/algorithms/appo/appo.py — IMPALA's async
+sampling loop with PPO's clipped surrogate objective;
+appo_tf_policy.py:120 the loss: importance ratio against the BEHAVIOR
+policy that sampled the fragment, clipped PPO-style, with V-trace
+advantages/targets correcting the off-policyness). Sampling never blocks
+on the learner (IMPALA's overlap), but each gradient step is
+trust-region-bounded like PPO — the middle ground between the two.
+
+Implementation: everything is inherited from IMPALA (arming loop,
+fragment consumption, bootstrap handling); only the compiled update
+differs, swapping V-trace's plain policy-gradient term for the clipped
+surrogate on the same V-trace advantages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .algorithm import AlgorithmConfig
+from .impala import IMPALA, vtrace
+from .models import ac_apply
+
+
+def make_appo_update(optimizer, gamma: float, vf_coeff: float,
+                     entropy_coeff: float, clip_param: float,
+                     rho_clip: float = 1.0, c_clip: float = 1.0):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, obs, actions, behavior_logp, rewards, dones,
+                bootstrap_value):
+        logits, values = ac_apply(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+        vs, pg_adv = vtrace(target_logp, behavior_logp, rewards, values,
+                            dones, bootstrap_value, gamma=gamma,
+                            rho_clip=rho_clip, c_clip=c_clip)
+        # PPO clipped surrogate with the ratio against the SAMPLING
+        # policy (appo_tf_policy.py's is_ratio * clip scheme)
+        ratio = jnp.exp(target_logp - behavior_logp)
+        surr = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * pg_adv)
+        pg_loss = -surr.mean()
+        vf_loss = jnp.square(values - vs).mean()
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_is_ratio": ratio.mean()}
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, behavior_logp, rewards,
+               dones, bootstrap_value):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, behavior_logp, rewards, dones,
+            bootstrap_value)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["total_loss"] = loss
+        return params, opt_state, stats
+
+    return update
+
+
+class APPO(IMPALA):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        # swap the plain V-trace policy gradient for the clipped
+        # surrogate; everything else (arming, fragment loop) is IMPALA's
+        self._update = make_appo_update(
+            self.optimizer, config.get("gamma", 0.99),
+            config.get("vf_loss_coeff", 0.5),
+            config.get("entropy_coeff", 0.01),
+            config.get("clip_param", 0.3))
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(APPO)
+        self.num_rollout_workers = 2
+        self.extra.update({"vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+                           "clip_param": 0.3})
+
+    def training(self, *, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, **kwargs) -> "APPOConfig":
+        super().training(**kwargs)
+        for k, v in (("clip_param", clip_param),
+                     ("vf_loss_coeff", vf_loss_coeff),
+                     ("entropy_coeff", entropy_coeff)):
+            if v is not None:
+                self.extra[k] = v
+        return self

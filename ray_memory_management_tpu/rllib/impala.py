@@ -22,36 +22,43 @@ from .algorithm import Algorithm, AlgorithmConfig
 from .models import ac_apply
 
 
+def vtrace(target_logp, behavior_logp, rewards, values, dones,
+           bootstrap_value, *, gamma: float, rho_clip: float = 1.0,
+           c_clip: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018) via a reverse scan.
+    Returns (vs, pg_adv), both stop-gradiented — shared by IMPALA's
+    plain policy gradient and APPO's clipped surrogate (appo.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.exp(target_logp - behavior_logp)
+    clipped_rho = jnp.minimum(rho, rho_clip)
+    cs = jnp.minimum(rho, c_clip)
+    discounts = gamma * (1.0 - dones)
+    next_values = jnp.concatenate(
+        [values[1:], jnp.array([bootstrap_value])])
+    deltas = clipped_rho * (rewards + discounts * next_values - values)
+
+    def scan_fn(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.float32(0.0), (deltas, discounts, cs),
+        reverse=True)
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], jnp.array([bootstrap_value])])
+    pg_adv = clipped_rho * (rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
 def make_impala_update(optimizer, gamma: float, vf_coeff: float,
                        entropy_coeff: float, rho_clip: float = 1.0,
                        c_clip: float = 1.0):
     import jax
     import jax.numpy as jnp
     import optax
-
-    def vtrace(target_logp, behavior_logp, rewards, values, dones,
-               bootstrap_value):
-        """V-trace targets (Espeholt et al. 2018) via a reverse scan."""
-        rho = jnp.exp(target_logp - behavior_logp)
-        clipped_rho = jnp.minimum(rho, rho_clip)
-        cs = jnp.minimum(rho, c_clip)
-        discounts = gamma * (1.0 - dones)
-        next_values = jnp.concatenate(
-            [values[1:], jnp.array([bootstrap_value])])
-        deltas = clipped_rho * (rewards + discounts * next_values - values)
-
-        def scan_fn(acc, xs):
-            delta, discount, c = xs
-            acc = delta + discount * c * acc
-            return acc, acc
-
-        _, vs_minus_v = jax.lax.scan(
-            scan_fn, jnp.float32(0.0), (deltas, discounts, cs),
-            reverse=True)
-        vs = values + vs_minus_v
-        next_vs = jnp.concatenate([vs[1:], jnp.array([bootstrap_value])])
-        pg_adv = clipped_rho * (rewards + discounts * next_vs - values)
-        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
     def loss_fn(params, obs, actions, behavior_logp, rewards, dones,
                 bootstrap_value):
@@ -60,7 +67,8 @@ def make_impala_update(optimizer, gamma: float, vf_coeff: float,
         target_logp = jnp.take_along_axis(
             logp_all, actions[:, None], axis=-1)[:, 0]
         vs, pg_adv = vtrace(target_logp, behavior_logp, rewards, values,
-                            dones, bootstrap_value)
+                            dones, bootstrap_value, gamma=gamma,
+                            rho_clip=rho_clip, c_clip=c_clip)
         pg_loss = -(target_logp * pg_adv).mean()
         vf_loss = jnp.square(values - vs).mean()
         entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()

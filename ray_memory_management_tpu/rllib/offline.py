@@ -19,11 +19,17 @@ import numpy as np
 
 from . import sample_batch as sb
 from .algorithm import Algorithm, AlgorithmConfig
+from .collector import NEXT_OBS
 from .env import make_env
 from .models import mlp_apply, mlp_init
 
 # behavior-policy action log-prob column (needed for off-policy evaluation)
 BEHAVIOR_LOGP = sb.LOGP
+# true environment termination, distinct from the episode-boundary DONES
+# (which also marks time-limit truncations): TD learners must bootstrap
+# through a truncation but not through a termination (collector.py applies
+# the same rule to live rollouts)
+TERMINATED = "terminated"
 
 
 class DatasetWriter:
@@ -38,6 +44,11 @@ class DatasetWriter:
         self._buf: List[Dict[str, np.ndarray]] = []
         self._buffered = 0
         self._shard = 0
+        # per-writer token: two writers appending to one directory (same
+        # pid or not) must never collide — shard-{pid}-{n} alone made a
+        # second same-process writer silently overwrite the first's
+        # shards, turning "append a second recording" into "replace"
+        self._uid = os.urandom(4).hex()
 
     def write(self, batch: Dict[str, np.ndarray]) -> None:
         self._buf.append({k: np.asarray(v) for k, v in batch.items()})
@@ -50,7 +61,8 @@ class DatasetWriter:
             return
         merged = sb.concat_batches(self._buf)
         fname = os.path.join(
-            self.path, f"shard-{os.getpid()}-{self._shard:05d}.npz")
+            self.path,
+            f"shard-{os.getpid()}-{self._uid}-{self._shard:05d}.npz")
         np.savez_compressed(fname + ".tmp.npz", **merged)
         os.replace(fname + ".tmp.npz", fname)  # readers never see partials
         self._shard += 1
@@ -63,17 +75,49 @@ class DatasetWriter:
 
 class DatasetReader:
     """Load a shard directory; serve shuffled minibatches (the
-    InputReader/JsonReader contract, json_reader.py:198,264)."""
+    InputReader/JsonReader contract, json_reader.py:198,264).
+
+    A directory may hold several independent RECORDINGS (one per
+    DatasetWriter — appended runs, parallel collectors). Shards are
+    grouped by their writer prefix so each recording's shards concatenate
+    in write order, and ``recording_starts`` marks where each recording
+    begins in the concatenated arrays: time order exists only WITHIN a
+    recording, and everything trajectory-shaped (episode splits, returns,
+    TD successors) must stop at those boundaries rather than bleed one
+    recording's truncated tail into the next recording's first episode.
+    Mixed schemas (a legacy recording without next_obs beside a new one)
+    keep the INTERSECTION of columns, so the reader never crashes or
+    keeps a column only some rows actually have."""
 
     def __init__(self, path: str, seed: int = 0):
+        import re
+
         files = sorted(
             os.path.join(path, f) for f in os.listdir(path)
             if f.endswith(".npz") and not f.endswith(".tmp.npz"))
         if not files:
             raise FileNotFoundError(f"no dataset shards under {path}")
-        shards = [dict(np.load(f)) for f in files]
+        groups: Dict[str, list] = {}
+        for f in files:
+            m = re.match(r"(.+)-(\d+)\.npz$", os.path.basename(f))
+            prefix, num = ((m.group(1), int(m.group(2))) if m
+                           else (os.path.basename(f), 0))
+            groups.setdefault(prefix, []).append((num, f))
+        loaded = [[dict(np.load(f)) for _, f in sorted(groups[p])]
+                  for p in sorted(groups)]
+        keys = None
+        for arrs in loaded:
+            for a in arrs:
+                keys = set(a) if keys is None else keys & set(a)
+        shards, starts, offset = [], [], 0
+        for arrs in loaded:
+            starts.append(offset)
+            for a in arrs:
+                shards.append({k: a[k] for k in keys})
+                offset += sb.batch_size(a)
         self.data = sb.concat_batches(shards)
         self.num_samples = sb.batch_size(self.data)
+        self.recording_starts = np.asarray(starts, np.int64)
         self._rng = np.random.default_rng(seed)
 
     def sample(self, n: int) -> Dict[str, np.ndarray]:
@@ -82,28 +126,37 @@ class DatasetReader:
 
     def iter_episodes(self, include_partial: bool = False
                       ) -> Iterator[Dict[str, np.ndarray]]:
-        """Split the (time-ordered) data at terminal flags — what the
-        trajectory-level OPE estimators consume. A trailing fragment with
-        no terminal flag is a TRUNCATED recording, not an episode: it is
-        excluded by default (treating it as complete biases per-episode
-        return estimates low; the reference's estimators likewise consume
-        only completed episodes)."""
+        """Split at terminal flags WITHIN each recording — what the
+        trajectory-level OPE estimators consume. A fragment that reaches
+        a recording boundary with no terminal flag is a TRUNCATED
+        recording, not an episode: it is excluded by default (treating
+        it as complete biases per-episode return estimates low; the
+        reference's estimators likewise consume only completed
+        episodes)."""
         dones = self.data[sb.DONES]
+        bounds = list(self.recording_starts[1:]) + [len(dones)]
         start = 0
-        for t in range(len(dones)):
-            if dones[t]:
-                yield {k: v[start:t + 1] for k, v in self.data.items()}
-                start = t + 1
-        if include_partial and start < len(dones):
-            yield {k: v[start:] for k, v in self.data.items()}
+        for rec_end in bounds:
+            for t in range(start, rec_end):
+                if dones[t]:
+                    yield {k: v[start:t + 1]
+                           for k, v in self.data.items()}
+                    start = t + 1
+            if start < rec_end:
+                if include_partial:
+                    yield {k: v[start:rec_end]
+                           for k, v in self.data.items()}
+                start = rec_end
 
 
 def collect_dataset(env_spec, path: str, num_steps: int = 10_000,
                     policy=None, env_config: Optional[dict] = None,
                     seed: int = 0, shard_size: int = 10_000) -> str:
     """Roll a policy (default: uniform random) through the env and write
-    (obs, action, reward, done, behavior logp) shards — the offline
-    counterpart of the reference's ``output`` rollout recording."""
+    (obs, action, reward, next_obs, done, behavior logp) shards — the
+    offline counterpart of the reference's ``output`` rollout recording.
+    next_obs makes the recording sufficient for TD-based offline
+    learners (CQL); return-based ones (BC/MARWIL) ignore it."""
     env = make_env(env_spec, env_config)
     rng = np.random.default_rng(seed)
     writer = DatasetWriter(path, shard_size=shard_size)
@@ -112,14 +165,17 @@ def collect_dataset(env_spec, path: str, num_steps: int = 10_000,
 
     def fresh() -> Dict[str, List]:
         return {sb.OBS: [], sb.ACTIONS: [], sb.REWARDS: [],
-                sb.DONES: [], BEHAVIOR_LOGP: []}
+                NEXT_OBS: [], sb.DONES: [], TERMINATED: [],
+                BEHAVIOR_LOGP: []}
 
     def emit(cols: Dict[str, List]) -> None:
         writer.write({
             sb.OBS: np.asarray(cols[sb.OBS], np.float32),
             sb.ACTIONS: np.asarray(cols[sb.ACTIONS], np.int32),
             sb.REWARDS: np.asarray(cols[sb.REWARDS], np.float32),
+            NEXT_OBS: np.asarray(cols[NEXT_OBS], np.float32),
             sb.DONES: np.asarray(cols[sb.DONES], np.float32),
+            TERMINATED: np.asarray(cols[TERMINATED], np.float32),
             BEHAVIOR_LOGP: np.asarray(cols[BEHAVIOR_LOGP], np.float32),
         })
 
@@ -134,7 +190,11 @@ def collect_dataset(env_spec, path: str, num_steps: int = 10_000,
         cols[sb.OBS].append(obs)
         cols[sb.ACTIONS].append(a)
         cols[sb.REWARDS].append(reward)
+        cols[NEXT_OBS].append(nxt)
+        # DONES marks the episode boundary (terminal OR time-limit);
+        # TERMINATED carries the true-terminal flag TD learners mask on
         cols[sb.DONES].append(float(terminated or truncated))
+        cols[TERMINATED].append(float(terminated))
         cols[BEHAVIOR_LOGP].append(logp)
         obs = nxt
         if terminated or truncated:
@@ -150,7 +210,34 @@ def collect_dataset(env_spec, path: str, num_steps: int = 10_000,
     return path
 
 
-class BC(Algorithm):
+class OfflineAlgorithm(Algorithm):
+    """Base for dataset-trained algorithms (BC/MARWIL/CQL): no rollout
+    workers, no weight broadcast; episode metrics come from periodic
+    greedy eval rollouts against a local env (the reference's
+    ``evaluation_interval`` rollouts for its offline family)."""
+
+    def _evaluate(self) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(self.eval_episodes):
+            obs = self.eval_env.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = self.eval_env.step(a)
+                total += r
+                done = term or trunc
+            rewards.append(total)
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes_total": len(rewards)}
+
+    def _episode_metrics(self) -> Dict[str, Any]:
+        return {}  # offline: metrics come from the eval rollouts above
+
+    def _sync_weights(self) -> None:
+        pass  # offline: no rollout workers exist to receive weights
+
+
+class BC(OfflineAlgorithm):
     """Behavior cloning: supervised cross-entropy on a recorded dataset —
     the reference's BC algorithm (rllib/algorithms/bc), the simplest
     member of its offline family. No environment interaction during
@@ -222,26 +309,6 @@ class BC(Algorithm):
         }
         out.update(self._evaluate())
         return out
-
-    def _evaluate(self) -> Dict[str, Any]:
-        rewards = []
-        for ep in range(self.eval_episodes):
-            obs = self.eval_env.reset(seed=1000 + ep)
-            total, done = 0.0, False
-            while not done:
-                a = self.compute_single_action(obs)
-                obs, r, term, trunc, _ = self.eval_env.step(a)
-                total += r
-                done = term or trunc
-            rewards.append(total)
-        return {"episode_reward_mean": float(np.mean(rewards)),
-                "episodes_total": len(rewards)}
-
-    def _episode_metrics(self) -> Dict[str, Any]:
-        return {}  # offline: metrics come from the eval rollouts above
-
-    def _sync_weights(self) -> None:
-        pass  # offline: no rollout workers exist to receive weights
 
     def compute_single_action(self, obs: np.ndarray) -> int:
         import jax.numpy as jnp

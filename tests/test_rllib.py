@@ -569,6 +569,232 @@ class TestOfflineRL:
         assert est["effective_sample_size"] > est["episodes"] * 0.99
 
 
+def _mixed_quality_dataset(tmp_path, expert_steps: int = 1250,
+                           random_steps: int = 3750):
+    """Mostly-random CartPole transitions with an expert minority — the
+    regime where advantage weighting (MARWIL) and conservatism (CQL)
+    matter and plain BC is dragged toward the (bad) majority policy."""
+    from ray_memory_management_tpu.rllib import collect_dataset
+
+    def expert(obs):
+        a = 1 if obs[2] + 0.3 * obs[3] > 0 else 0
+        return a, -0.05
+
+    path = str(tmp_path / "mixed")
+    collect_dataset("CartPole", path, num_steps=expert_steps,
+                    policy=expert,
+                    env_config={"max_episode_steps": 200}, seed=0)
+    collect_dataset("CartPole", path, num_steps=random_steps, policy=None,
+                    env_config={"max_episode_steps": 200}, seed=1)
+    from ray_memory_management_tpu.rllib.offline import DatasetReader
+
+    # both recordings must land (a second same-directory writer used to
+    # overwrite the first's shards)
+    assert DatasetReader(path).num_samples == expert_steps + random_steps
+    return path
+
+
+class TestMARWIL:
+    def test_beats_bc_on_mixed_data(self, tmp_path):
+        """Advantage re-weighting follows the expert half of a mixed
+        dataset where plain cloning imitates the average policy
+        (marwil.py's Wang et al. 2018 contract; the reference's
+        tuned_examples/marwil/cartpole-marwil.yaml, CI-scaled).
+        beta=0 must degenerate to BC exactly (uniform weights)."""
+        from ray_memory_management_tpu.rllib import (BCConfig,
+                                                     MARWILConfig)
+
+        path = _mixed_quality_dataset(tmp_path)
+
+        def run(config):
+            algo = (config
+                    .environment("CartPole",
+                                 env_config={"max_episode_steps": 500})
+                    .offline_data(input_path=path)
+                    .training(lr=1e-3, train_batch_size=256,
+                              updates_per_step=100, eval_episodes=3)
+                    .debugging(seed=0)
+                    .build())
+            result = {}
+            for _ in range(8):
+                result = algo.train()
+            algo.stop()
+            return result
+
+        marwil = run(MARWILConfig())
+        bc = run(BCConfig())
+        # the re-weighted clone should clearly outperform the average-
+        # policy clone on mixed data
+        assert marwil["episode_reward_mean"] > 120, marwil
+        assert (marwil["episode_reward_mean"]
+                > bc["episode_reward_mean"] + 30), (marwil, bc)
+        # weights really spread (expert rows upweighted vs random rows)
+        assert marwil["mean_weight"] > 0
+
+    def test_beta_zero_weights_are_uniform(self, tmp_path):
+        from ray_memory_management_tpu.rllib import MARWILConfig
+
+        path = _mixed_quality_dataset(tmp_path, expert_steps=300,
+                                      random_steps=300)
+        algo = (MARWILConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 50})
+                .offline_data(input_path=path)
+                .training(beta=0.0, train_batch_size=128,
+                          updates_per_step=8, eval_episodes=1)
+                .debugging(seed=0)
+                .build())
+        result = algo.train()
+        # exp(0 * adv / c) == 1 for every row
+        assert abs(result["mean_weight"] - 1.0) < 1e-5, result
+        algo.stop()
+
+
+class TestCQL:
+    def test_learns_cartpole_offline(self, tmp_path):
+        """Conservative Q-learning reaches the reward threshold from a
+        fixed mixed-quality dataset with no environment interaction
+        (cql.py; the reference's CQL contract on offline data). The
+        conservative penalty must be active (positive logsumexp gap)."""
+        from ray_memory_management_tpu.rllib import CQLConfig
+
+        path = _mixed_quality_dataset(tmp_path)
+        algo = (CQLConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 500})
+                .offline_data(input_path=path)
+                .training(lr=5e-4, gamma=0.99, cql_alpha=1.0,
+                          train_batch_size=256, updates_per_step=150,
+                          target_update_freq=100, eval_episodes=3)
+                .debugging(seed=0)
+                .build())
+        result = {}
+        best = 0.0
+        for _ in range(10):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best > 150:
+                break
+        assert best > 120, (best, result)
+        assert result["cql_penalty"] > 0, result
+        # checkpoint round-trip preserves the target net + Adam moments
+        blob = algo.save()
+        algo.stop()
+        algo2 = (CQLConfig()
+                 .environment("CartPole",
+                              env_config={"max_episode_steps": 500})
+                 .offline_data(input_path=path)
+                 .training(train_batch_size=256, updates_per_step=1)
+                 .debugging(seed=0)
+                 .build())
+        algo2.restore(blob)
+        assert algo2._updates_done == algo._updates_done
+        algo2.stop()
+
+    def test_boundary_semantics_across_recordings(self, tmp_path):
+        """Appended recordings are independent streams: returns must not
+        accumulate across the boundary, a recording's truncated tail is
+        invalid, TD successors never cross recordings, and the reader
+        keeps the column intersection of mixed-schema shards."""
+        import numpy as np
+
+        from ray_memory_management_tpu.rllib import sample_batch as sb
+        from ray_memory_management_tpu.rllib.collector import NEXT_OBS
+        from ray_memory_management_tpu.rllib.cql import derive_next_obs
+        from ray_memory_management_tpu.rllib.marwil import episode_returns
+        from ray_memory_management_tpu.rllib.offline import (
+            DatasetReader, DatasetWriter)
+
+        # recording A rows 0-2 (episode 0-1, truncated tail 2); B rows 3-5
+        rewards = np.ones(6, np.float32)
+        dones = np.array([0, 1, 0, 0, 1, 0], np.float32)
+        starts = np.array([0, 3])
+        returns, valid = episode_returns(rewards, dones, 1.0, starts)
+        assert valid.tolist() == [1, 1, 0, 1, 1, 0]
+        assert returns[0] == 2  # stops at A's own episode end
+        assert returns[2] == 1  # tail: no bleed into B's returns
+        assert returns[3] == 2
+
+        obs = np.arange(6, dtype=np.float32)[:, None]
+        data = {sb.OBS: obs, sb.DONES: dones,
+                sb.ACTIONS: np.zeros(6, np.int32), sb.REWARDS: rewards}
+        out = derive_next_obs(data, starts)
+        # both truncated tails (rows 2 and 5) dropped, episodes intact
+        assert len(out[sb.OBS]) == 4
+        np.testing.assert_allclose(out[NEXT_OBS][0], obs[1])
+
+        # reader: two writers, one legacy (no next_obs) — intersection
+        w1 = DatasetWriter(str(tmp_path / "d"))
+        w1.write({sb.OBS: obs[:3], sb.ACTIONS: np.zeros(3, np.int32),
+                  sb.REWARDS: rewards[:3], sb.DONES: dones[:3],
+                  NEXT_OBS: obs[:3]})
+        w1.close()
+        w2 = DatasetWriter(str(tmp_path / "d"))
+        w2.write({sb.OBS: obs[3:], sb.ACTIONS: np.zeros(3, np.int32),
+                  sb.REWARDS: rewards[3:], sb.DONES: dones[3:]})
+        w2.close()
+        r = DatasetReader(str(tmp_path / "d"))
+        assert r.num_samples == 6
+        assert len(r.recording_starts) == 2
+        assert NEXT_OBS not in r.data  # intersection, never a ragged col
+        # iter_episodes: exactly the two complete episodes, no merged
+        # cross-recording fragment
+        eps = list(r.iter_episodes())
+        assert len(eps) == 2
+        assert all(sb.batch_size(e) == 2 for e in eps)
+
+    def test_derive_next_obs_for_legacy_datasets(self, tmp_path):
+        """Datasets recorded before the next_obs column can still feed
+        CQL: successors are back-filled from the time order and the
+        truncated tail row is dropped."""
+        import numpy as np
+
+        from ray_memory_management_tpu.rllib import sample_batch as sb
+        from ray_memory_management_tpu.rllib.cql import derive_next_obs
+
+        obs = np.arange(10, dtype=np.float32)[:, None]
+        dones = np.zeros(10, np.float32)
+        dones[4] = 1.0  # one completed episode, then a truncated tail
+        data = {sb.OBS: obs, sb.DONES: dones,
+                sb.ACTIONS: np.zeros(10, np.int32),
+                sb.REWARDS: np.ones(10, np.float32)}
+        out = derive_next_obs(data)
+        assert len(out[sb.OBS]) == 9  # non-terminal tail row dropped
+        from ray_memory_management_tpu.rllib.collector import NEXT_OBS
+
+        # within-episode successor: next_obs[t] == obs[t+1]
+        np.testing.assert_allclose(out[NEXT_OBS][0], obs[1])
+        np.testing.assert_allclose(out[NEXT_OBS][7], obs[8])
+
+
+class TestAPPO:
+    def test_learns_async(self, rmt_start_regular):
+        """Async PPO: IMPALA's overlap with the clipped surrogate —
+        learning regression mirrors IMPALA's (appo.py)."""
+        from ray_memory_management_tpu.rllib import APPOConfig
+
+        algo = (APPOConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 200})
+                .rollouts(num_rollout_workers=2,
+                          rollout_fragment_length=200)
+                .training(train_batch_size=1600, lr=1e-3,
+                          clip_param=0.3)
+                .debugging(seed=1)
+                .build())
+        first = None
+        result = {}
+        for _ in range(7):
+            result = algo.train()
+            if first is None:
+                first = result["episode_reward_mean"]
+        assert result["episode_reward_mean"] > 1.5 * first
+        # the surrogate really ran against the behavior policy
+        assert "mean_is_ratio" in result
+        assert 0.2 < result["mean_is_ratio"] < 5.0
+        algo.stop()
+
+
 class TestConnectors:
     """Env->policy transform pipeline (the reference's connector
     framework, rllib/connectors/): unit contracts per transform, state
