@@ -32,7 +32,11 @@ class ClientBackend:
             target=self._recv_loop, daemon=True, name="rmt-client-recv")
         self._recv_thread.start()
         self.inline_limit = 100 * 1024  # parity with driver-side encoding
-        self._request({"type": "ping"})  # fail fast on a bad address
+        from ..config import WIRE_PROTOCOL_VERSION
+
+        # fail fast on a bad address AND on a version-skewed server (the
+        # server raises a mismatch error back through this request)
+        self._request({"type": "ping", "proto": WIRE_PROTOCOL_VERSION})
 
     # -- transport ------------------------------------------------------------
     def _recv_loop(self) -> None:

@@ -27,16 +27,45 @@ from ..ids import JobID
 class _JobState:
     """Per-connection resource ledger, reclaimed on disconnect."""
 
-    __slots__ = ("job_id", "actors", "pgs", "puts", "mu", "closed")
+    __slots__ = ("job_id", "actors", "pgs", "puts", "refs", "mu", "closed")
 
     def __init__(self, job_id: bytes):
         self.job_id = job_id
         self.actors: set = set()
         self.pgs: set = set()
         self.puts: set = set()
+        # live ObjectRef objects for call_named returns: holding them
+        # keeps the driver-side refcount pinning the return values until
+        # the frontend disconnects (a non-Python frontend has no
+        # distributed-refcount participation of its own)
+        self.refs: list = []
         self.mu = threading.Lock()
         self.closed = False  # set by _reclaim_job; late tracks reclaim
         # inline instead of landing in an already-drained ledger
+
+
+# Named-function registry for non-Python frontends (the C++ client,
+# native/client/): compute stays registered cluster-side in Python, and a
+# frontend drives it by name with bytes in / bytes out — the reference's
+# cross-language boundary likewise moves opaque buffers between language
+# frontends rather than pickled object graphs (its msgpack XLANG format).
+_named_functions: Dict[str, dict] = {}
+
+
+def register_named_function(name: str, fn, **default_opts) -> None:
+    """Expose ``fn`` to non-Python frontends as ``name``. The function
+    receives the frontend's raw ``bytes`` args and should return bytes
+    (rich returns remain fetchable from Python clients). The remote
+    wrapper is built once here and cached per options-set: rebuilding it
+    per call would mint a fresh function id each time, growing every
+    worker's function cache and re-shipping the pickled function per
+    call."""
+    _named_functions[name] = {"fn": fn, "defaults": default_opts,
+                              "remote_cache": {}}
+
+
+def unregister_named_function(name: str) -> None:
+    _named_functions.pop(name, None)
 
 
 class ClusterServer:
@@ -110,6 +139,7 @@ class ClusterServer:
             job.actors.clear()
             job.pgs.clear()
             job.puts.clear()
+            job.refs.clear()  # drop call_named returns: refcount frees them
         for aid in actors:
             self._reclaim_one("actors", aid)
         for pg_id in pgs:
@@ -209,7 +239,74 @@ class ClusterServer:
                 from ..core.placement_group import _manager
 
                 _manager(rt).remove(msg["pg_id"])
+            elif mtype == "list_named":
+                reply["names"] = sorted(_named_functions)
+            elif mtype == "call_named":
+                from .. import api
+
+                name = msg["name"]
+                if name not in _named_functions:
+                    raise KeyError(
+                        f"no function registered as {name!r}; the cluster "
+                        "side must call register_named_function first")
+                entry = _named_functions[name]
+                opts = {**entry["defaults"], **(msg.get("opts") or {})}
+                key = tuple(sorted(opts.items()))
+                rf = entry["remote_cache"].get(key)
+                if rf is None:
+                    rf = api.remote(entry["fn"])
+                    if opts:
+                        rf = rf.options(**opts)
+                    entry["remote_cache"][key] = rf
+                refs = rf.remote(*[bytes(a) for a in msg.get("args", [])])
+                refs = list(refs) if isinstance(refs, (list, tuple)) \
+                    else [refs]
+                with job.mu:
+                    if not job.closed:
+                        job.refs.extend(refs)
+                reply["return_ids"] = [r.binary() for r in refs]
+            elif mtype == "free_refs":
+                # steady-state release for long-lived frontends: drop the
+                # pinned call_named returns / put_bytes objects for these
+                # ids so the store does not grow monotonically
+                ids = {bytes(o) for o in msg["oids"]}
+                with job.mu:
+                    job.refs = [r for r in job.refs
+                                if r.binary() not in ids]
+                    puts = [o for o in ids if o in job.puts]
+                    for o in puts:
+                        job.puts.discard(o)
+                if puts:
+                    rt.free_objects(puts)
+            elif mtype == "put_bytes":
+                # raw-buffer puts for non-Python frontends: the value IS
+                # the bytes (no pickle envelope crosses the wire)
+                oid = rt.put_object(bytes(msg["data"]))
+                track("puts", oid)
+                reply["object_id"] = oid
+            elif mtype == "get_bytes":
+                values = rt.get_objects(msg["oids"], msg.get("timeout"))
+                out = []
+                for v in values:
+                    if isinstance(v, (bytes, bytearray, memoryview)):
+                        out.append(bytes(v))
+                    else:
+                        raise TypeError(
+                            "get_bytes fetched a non-bytes value of type "
+                            f"{type(v).__name__}; rich values need a "
+                            "Python client")
+                reply["values"] = out
             elif mtype == "ping":
+                from ..config import WIRE_PROTOCOL_VERSION
+
+                # strict: a MISSING proto is a pre-versioning peer, the
+                # exact population the check exists to refuse
+                proto = msg.get("proto")
+                if proto != WIRE_PROTOCOL_VERSION:
+                    raise ValueError(
+                        "wire protocol mismatch: server speaks "
+                        f"v{WIRE_PROTOCOL_VERSION}, client spoke "
+                        f"v{proto} — upgrade the older side")
                 reply["pong"] = True
             else:
                 raise ValueError(f"unknown client request {mtype!r}")

@@ -126,6 +126,14 @@ def _coerce(typ, raw: str):
     return typ(raw)
 
 
+# Version of every cross-process wire schema (node registration, thin-client
+# requests, transfer-plane fetches — the reference versions its protobuf
+# schemas the same way, src/ray/protobuf/). Strict equality: a mixed-version
+# cluster fails LOUDLY at the handshake with both versions named, instead of
+# mis-parsing a frame mid-run. Bump on ANY incompatible message change.
+WIRE_PROTOCOL_VERSION = 1
+
+
 class Config:
     """A scoped snapshot of all flags, with ``RMT_<NAME>`` env overrides
     applied at construction time (the reference reads ``RAY_<name>`` once at

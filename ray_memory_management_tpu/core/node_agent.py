@@ -84,8 +84,11 @@ class NodeAgent:
             sock.close()
         except OSError:
             pass
+        from ..config import WIRE_PROTOCOL_VERSION
+
         self._send({
             "type": "register_node",
+            "proto": WIRE_PROTOCOL_VERSION,
             "num_cpus": num_cpus,
             "num_tpus": num_tpus,
             "resources": resources or {},

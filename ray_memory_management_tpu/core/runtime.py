@@ -619,6 +619,24 @@ class Runtime:
             if msg.get("type") != "register_node":
                 conn.close()
                 continue
+            from ..config import WIRE_PROTOCOL_VERSION
+
+            if msg.get("proto") != WIRE_PROTOCOL_VERSION:
+                # mixed-version cluster: refuse at the handshake, with
+                # both versions named, rather than mis-parse frames later
+                try:
+                    conn.send({
+                        "type": "error",
+                        "error": (
+                            "wire protocol mismatch: head speaks "
+                            f"v{WIRE_PROTOCOL_VERSION}, agent spoke "
+                            f"v{msg.get('proto')} — upgrade the older "
+                            "side"),
+                    })
+                except (OSError, BrokenPipeError):
+                    pass
+                conn.close()
+                continue
             node_id = NodeID.from_random()
             res = task_resources(
                 num_cpus=msg.get("num_cpus", 4),

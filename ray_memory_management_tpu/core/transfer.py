@@ -13,8 +13,9 @@ per object_manager.proto:63-67) with admission control collapsed to two
 caps: concurrent serving connections per source (the PullManager in-flight
 cap analog, pull_manager.h:47) and concurrent fetches per destination.
 
-Wire protocol (authenticated ``multiprocessing.connection``):
-    client -> server   {"oid": <bytes>}
+Wire protocol (authenticated ``multiprocessing.connection``; versioned by
+config.WIRE_PROTOCOL_VERSION — mismatches are refused at the request):
+    client -> server   {"oid": <bytes>, "proto": <int>}
     server -> client   {"size": <int>}   or   {"error": <str>}
     server -> client   raw chunk frames until ``size`` bytes are sent
 """
@@ -107,6 +108,15 @@ class TransferServer:
         with self._sem:
             try:
                 req = conn.recv()
+                from ..config import WIRE_PROTOCOL_VERSION
+
+                # strict: a missing proto is a pre-versioning peer
+                if req.get("proto") != WIRE_PROTOCOL_VERSION:
+                    conn.send({"error": (
+                        "wire protocol mismatch: server speaks "
+                        f"v{WIRE_PROTOCOL_VERSION}, peer spoke "
+                        f"v{req.get('proto')}")})
+                    return
                 oid = req["oid"]
                 view = self.store.read(oid)
                 if view is None:
@@ -197,7 +207,9 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
     except Exception as e:  # noqa: BLE001 — peer down / auth refused
         return f"connect to {host}:{port} failed: {e!r}"
     try:
-        conn.send({"oid": oid})
+        from ..config import WIRE_PROTOCOL_VERSION
+
+        conn.send({"oid": oid, "proto": WIRE_PROTOCOL_VERSION})
         hdr = conn.recv()
         err = hdr.get("error")
         if err:

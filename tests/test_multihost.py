@@ -305,3 +305,60 @@ class TestAnyHolderServes:
             assert isinstance(cli, StoreClient), type(cli)
         finally:
             rmt.shutdown()
+
+
+class TestWireVersioning:
+    """Every cross-process schema carries config.WIRE_PROTOCOL_VERSION;
+    a version-skewed peer is refused at the handshake with both versions
+    named (the reference versions its protobuf schemas the same way)."""
+
+    def test_node_registration_rejects_mismatch(self):
+        from multiprocessing.connection import Client
+
+        rt = rmt.init(num_cpus=2)
+        try:
+            host, port = rt.node_listener_address
+            conn = Client((host, port), authkey=rt._authkey)
+            conn.send({"type": "register_node", "proto": 999,
+                       "num_cpus": 1, "hostname": "skewed", "pid": 1})
+            reply = conn.recv()
+            assert reply["type"] == "error"
+            assert "protocol mismatch" in reply["error"]
+            assert "v999" in reply["error"]
+            conn.close()
+            # and a CURRENT-version agent still registers fine
+            nid = rt.add_remote_node_process(num_cpus=1)
+            assert nid in rt.nodes
+        finally:
+            rmt.shutdown()
+
+    def test_client_ping_rejects_mismatch(self):
+        from multiprocessing.connection import Client
+
+        from ray_memory_management_tpu import serialization as ser
+        from ray_memory_management_tpu.client.client import ClientBackend
+        from ray_memory_management_tpu.client.server import ClusterServer
+
+        rt = rmt.init(num_cpus=2)
+        server = None
+        try:
+            server = ClusterServer()
+            host, port = server.address
+            # a skewed client, simulated on the raw wire (both sides of an
+            # in-process patch would see the same module attribute)
+            conn = Client((host, port), family="AF_INET",
+                          authkey=b"rmt-client")
+            conn.send({"type": "ping", "proto": 998, "req_id": 1})
+            reply = conn.recv()
+            assert "error" in reply
+            err = ser.loads(reply["error"])
+            assert "protocol mismatch" in str(err)
+            assert "v998" in str(err)
+            conn.close()
+            # current version connects (ClientBackend pings on init)
+            backend = ClientBackend(host, port)
+            backend.close()
+        finally:
+            if server is not None:
+                server.close()
+            rmt.shutdown()
