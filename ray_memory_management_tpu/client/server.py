@@ -251,7 +251,11 @@ class ClusterServer:
                         "side must call register_named_function first")
                 entry = _named_functions[name]
                 opts = {**entry["defaults"], **(msg.get("opts") or {})}
-                key = tuple(sorted(opts.items()))
+                # repr-keyed: option values may be dicts (resources={...})
+                # which are unhashable; a repr collision is impossible for
+                # these plain-literal option sets and a repr MISS is just
+                # a cache rebuild
+                key = repr(sorted(opts.items()))
                 rf = entry["remote_cache"].get(key)
                 if rf is None:
                     rf = api.remote(entry["fn"])

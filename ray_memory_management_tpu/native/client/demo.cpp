@@ -39,6 +39,18 @@ int main(int argc, char** argv) {
     std::printf("GET roundtrip=%s\n",
                 values.size() == 1 && values[0] == payload ? "ok" : "MISMATCH");
 
+    // duplicate-id fetch of a large payload: the server pickles the
+    // repeated value as a memo BINGET, which the unpickler must resolve
+    // (regression: the memo once skipped large bytes)
+    std::string big(100 * 1024, 'x');
+    std::string big_id = client.Put(big);
+    auto twice = client.Get({big_id, big_id});
+    std::printf("DUPGET %s\n",
+                twice.size() == 2 && twice[0] == big && twice[1] == big
+                    ? "ok"
+                    : "MISMATCH");
+    client.Free({big_id});
+
     // named-function call: cluster-side Python computes on our bytes
     auto names = client.ListFunctions();
     bool found = false;

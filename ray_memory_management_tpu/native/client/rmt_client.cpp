@@ -161,7 +161,14 @@ PyVal PvStr(const std::string& v) {
   PyVal p; p.kind = PyVal::Kind::Str; p.s = v; return p;
 }
 PyVal PvBytes(const std::string& v) {
-  PyVal p; p.kind = PyVal::Kind::Bytes; p.s = v; return p;
+  PyVal p;
+  p.kind = PyVal::Kind::Bytes;
+  if (v.size() > 4096) {
+    p.big = std::make_shared<const std::string>(v);
+  } else {
+    p.s = v;
+  }
+  return p;
 }
 PyVal PvList(std::vector<PyVal> v) {
   PyVal p; p.kind = PyVal::Kind::List; p.list = std::move(v); return p;
@@ -208,11 +215,13 @@ void PickleValue(std::string* out, const PyVal& v) {
       PutLE32(out, uint32_t(v.s.size()));
       out->append(v.s);
       break;
-    case PyVal::Kind::Bytes:
+    case PyVal::Kind::Bytes: {
+      const std::string& payload = v.bytes();
       out->push_back('B');  // BINBYTES (protocol 3) <LE32 len> <raw>
-      PutLE32(out, uint32_t(v.s.size()));
-      out->append(v.s);
+      PutLE32(out, uint32_t(payload.size()));
+      out->append(payload);
       break;
+    }
     case PyVal::Kind::List:
       out->push_back(']');  // EMPTY_LIST
       if (!v.list.empty()) {
@@ -304,29 +313,18 @@ PyVal Unpickle(const std::string& data) {
   Reader r(data);
   std::vector<PyVal> stack;
   std::vector<size_t> marks;
-  // memo entries are COPIES; protocol-5 picklers MEMOIZE every bytes
-  // object, so copying a multi-GB Get() payload into the memo would
-  // double peak memory for an entry replies never BINGET. Large bytes
-  // are skipped (memo_valid=0) and only fault if actually fetched.
+  // memo entries are COPIES, but large bytes payloads sit behind a
+  // shared_ptr inside PyVal, so protocol-5's MEMOIZE-every-bytes habit
+  // costs pointer copies, not buffer copies — and duplicate-id fetches
+  // (BINGET of a repeated payload) resolve correctly
   std::vector<PyVal> memo;
-  std::vector<uint8_t> memo_valid;
-  constexpr size_t kMemoBytesCap = 4096;
 
   auto memoPut = [&](size_t idx, const PyVal& v) {
-    if (memo.size() <= idx) {
-      memo.resize(idx + 1);
-      memo_valid.resize(idx + 1, 0);
-    }
-    if (v.kind == PyVal::Kind::Bytes && v.s.size() > kMemoBytesCap) {
-      memo_valid[idx] = 0;  // placeholder; BINGET on it throws
-      return;
-    }
+    if (memo.size() <= idx) memo.resize(idx + 1);
     memo[idx] = v;
-    memo_valid[idx] = 1;
   };
   auto memoGet = [&](size_t idx) -> const PyVal& {
-    if (idx >= memo.size() || !memo_valid[idx])
-      throw ClientError("pickle: BINGET of unmemoized large payload");
+    if (idx >= memo.size()) throw ClientError("pickle: BINGET range");
     return memo[idx];
   };
 
@@ -622,12 +620,20 @@ Client::Client(const std::string& host, int port, const std::string& authkey) {
   freeaddrinfo(res);
   int one = 1;
   setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  Handshake(authkey);
-  // version-checked ping (the server raises on wire-protocol mismatch)
-  std::map<std::string, PyVal> ping;
-  ping["type"] = PvStr("ping");
-  ping["proto"] = PvInt(1);  // config.WIRE_PROTOCOL_VERSION
-  Request(std::move(ping));
+  try {
+    Handshake(authkey);
+    // version-checked ping (the server raises on wire-protocol mismatch)
+    std::map<std::string, PyVal> ping;
+    ping["type"] = PvStr("ping");
+    ping["proto"] = PvInt(1);  // config.WIRE_PROTOCOL_VERSION
+    Request(std::move(ping));
+  } catch (...) {
+    // the destructor never runs for a partially constructed object:
+    // close here or every failed connect leaks an fd
+    ::close(fd_);
+    fd_ = -1;
+    throw;
+  }
 }
 
 Client::~Client() { Close(); }

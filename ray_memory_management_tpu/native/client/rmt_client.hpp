@@ -38,7 +38,11 @@ struct PyVal {
   bool b = false;
   int64_t i = 0;
   double f = 0.0;
-  std::string s;                 // Str and Bytes payloads
+  std::string s;                 // Str and small Bytes payloads
+  // large Bytes payloads live behind a shared pointer so copying a
+  // PyVal (pickle memo entries, duplicate-id fetches) never duplicates
+  // a multi-GB buffer
+  std::shared_ptr<const std::string> big;
   std::vector<PyVal> list;       // List (and tuples, decoded as lists)
   std::map<std::string, PyVal> dict;
 
@@ -46,7 +50,7 @@ struct PyVal {
   const std::string& bytes() const {
     if (kind != Kind::Bytes && kind != Kind::Str)
       throw std::runtime_error("PyVal: not bytes");
-    return s;
+    return big ? *big : s;
   }
 };
 

@@ -19,6 +19,7 @@ from .appo import APPO, APPOConfig  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
+from .es import ES, ESConfig  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,
     Env,
@@ -35,6 +36,7 @@ from .offline import (  # noqa: F401
     collect_dataset,
     importance_sampling_estimate,
 )
+from .pg import A2CConfig, PG, PGConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401

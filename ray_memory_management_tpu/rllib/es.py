@@ -1,0 +1,309 @@
+"""ES: OpenAI evolution strategies — gradient-free, embarrassingly parallel.
+
+The reference's ES (rllib/algorithms/es/es.py — Salimans et al. 2017:
+perturb a flat parameter vector with antithetic Gaussian noise, evaluate
+each perturbation as a full episode on a worker, update with the
+centered-rank-weighted sum of the noise; rllib/algorithms/es/optimizers.py
+the SGD/Adam step on that pseudo-gradient; utils.py:14 the shared noise
+table workers index into).
+
+Redesigned for this runtime's strengths: there is NO noise table. The
+reference ships a 250 MB shared noise block to every worker and exchanges
+indices into it; here each perturbation is identified by its PRNG SEED —
+workers regenerate eps = normal(key(seed)) locally, evaluate theta ± sigma
+* eps, and return (seed, fitness+, fitness-) tuples. The broadcast is just
+the base parameter vector, the collection is a few floats per rollout, and
+the learner reconstructs every eps inside ONE jit'd vmap to apply the
+rank-weighted update on the accelerator — communication drops from
+O(noise table) to O(params + 3 floats per perturbation).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb  # noqa: F401  (kept for API parity)
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import make_env
+from .models import mlp_apply, mlp_init
+from .rollout_worker import WorkerSet
+
+
+def flatten_params(params) -> np.ndarray:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    return np.concatenate([np.asarray(p).ravel() for p in leaves])
+
+
+def unflatten_params(flat: np.ndarray, template):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out, pos = [], 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        out.append(np.asarray(flat[pos:pos + n], np.float32).reshape(
+            leaf.shape))
+        pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def centered_ranks(fitness: np.ndarray) -> np.ndarray:
+    """Map fitnesses to centered ranks in [-0.5, 0.5] (es.py's
+    compute_centered_ranks) — scale-free, outlier-robust weighting."""
+    ranks = np.empty(len(fitness), dtype=np.float32)
+    ranks[fitness.argsort()] = np.arange(len(fitness), dtype=np.float32)
+    return ranks / (len(fitness) - 1) - 0.5
+
+
+def _perturbation(seed: int, dim: int) -> np.ndarray:
+    """The noise for one perturbation, derived from its seed — identical
+    on worker (rollout) and learner (update) by PRNG determinism."""
+    import jax
+
+    return np.asarray(jax.random.normal(
+        jax.random.PRNGKey(seed), (dim,), dtype=np.float32))
+
+
+class ESRolloutWorker:
+    """Evaluates antithetic perturbation pairs: for each seed, one
+    episode with theta + sigma*eps and one with theta - sigma*eps
+    (es.py's do_rollouts with antithetic sampling)."""
+
+    def __init__(self, env_spec, env_config: Optional[dict], hidden,
+                 sigma: float, seed: int):
+        import jax
+
+        from .. import _worker_context
+
+        if _worker_context.in_worker():
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        self.env = make_env(env_spec, env_config)
+        self.sigma = sigma
+        self.discrete = hasattr(self.env, "num_actions")
+        out_dim = (self.env.num_actions if self.discrete
+                   else int(getattr(self.env, "action_dim", 1)))
+        self.template = mlp_init(
+            jax.random.key(0),
+            [self.env.observation_dim, *hidden, out_dim])
+        self.theta = flatten_params(self.template)
+        self.rng = np.random.default_rng(seed)
+        self.episode_rewards: List[float] = []
+        self.episode_lengths: List[int] = []
+
+    def ready(self) -> str:
+        return "ok"
+
+    def set_weights(self, theta: np.ndarray) -> None:
+        self.theta = np.asarray(theta, np.float32)
+
+    def _episode(self, flat: np.ndarray) -> float:
+        import jax.numpy as jnp
+
+        params = unflatten_params(flat, self.template)
+        obs = self.env.reset(seed=int(self.rng.integers(1 << 31)))
+        total, steps, done = 0.0, 0, False
+        while not done:
+            out = np.asarray(mlp_apply(params, jnp.asarray(obs[None, :])))[0]
+            if self.discrete:
+                a = int(out.argmax())
+            else:
+                bound = float(getattr(self.env, "action_bound", 1.0))
+                a = bound * np.tanh(out)
+            obs, r, term, trunc, _ = self.env.step(a)
+            total += r
+            steps += 1
+            done = term or trunc
+        self.episode_rewards.append(total)
+        self.episode_lengths.append(steps)
+        return total
+
+    def evaluate(self, seeds: List[int]) -> Dict[str, np.ndarray]:
+        """One antithetic pair of episodes per seed."""
+        pos = np.zeros(len(seeds), np.float32)
+        neg = np.zeros(len(seeds), np.float32)
+        for i, s in enumerate(seeds):
+            eps = _perturbation(s, len(self.theta))
+            pos[i] = self._episode(self.theta + self.sigma * eps)
+            neg[i] = self._episode(self.theta - self.sigma * eps)
+        return {"seeds": np.asarray(seeds, np.int64),
+                "pos": pos, "neg": neg}
+
+    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
+        rewards = self.episode_rewards[-window:]
+        lengths = self.episode_lengths[-window:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else None,
+            "episode_len_mean": float(np.mean(lengths)) if lengths
+            else None,
+        }
+
+
+class _ESWorkerSet(WorkerSet):
+    def __init__(self, env_spec, env_config, hidden, sigma,
+                 num_workers: int, seed: int):
+        cls = api.remote(ESRolloutWorker)
+        self.remote_workers = [
+            cls.options(num_cpus=1).remote(
+                env_spec, env_config, hidden, sigma,
+                seed + 1000 * (i + 1))
+            for i in range(num_workers)
+        ]
+        api.get([w.ready.remote() for w in self.remote_workers])
+
+
+def make_es_update(lr: float, sigma: float, l2: float):
+    """The rank-weighted pseudo-gradient step, reconstructing every
+    perturbation from its seed inside one jit (vmapped PRNG)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def update(theta, seeds, weights):
+        def eps_for(seed):
+            return jax.random.normal(
+                jax.random.PRNGKey(seed), theta.shape, dtype=jnp.float32)
+
+        eps = jax.vmap(eps_for)(seeds)          # [n, dim]
+        grad = (weights @ eps) / (len(weights) * sigma)
+        return theta + lr * (grad - l2 * theta)
+
+    return update
+
+
+class ES(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+
+        self.cfg = config
+        if config.get("connectors"):
+            raise ValueError(
+                "connectors are not supported by ES's episode-return "
+                "evaluation workers")
+        seed = config.get("seed", 0)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        hidden = config.get("hidden", (32,))
+        discrete = hasattr(probe_env, "num_actions")
+        out_dim = (probe_env.num_actions if discrete
+                   else int(getattr(probe_env, "action_dim", 1)))
+        self.template = mlp_init(
+            jax.random.key(seed),
+            [probe_env.observation_dim, *hidden, out_dim])
+        self.theta = flatten_params(self.template)
+        self.sigma = config.get("sigma", 0.05)
+        self.episodes_per_step = config.get("episodes_per_batch", 64)
+        self._update = make_es_update(
+            config.get("lr", 0.02), self.sigma,
+            config.get("l2_coeff", 0.005))
+        self._rng = np.random.default_rng(seed)
+        self._discrete = discrete
+        self._probe_env = probe_env
+        self._timesteps_total = 0
+        self._updates_done = 0
+
+        n_workers = config.get("num_rollout_workers", 0)
+        self.workers = None
+        self.local_worker = None
+        if n_workers > 0:
+            self.workers = _ESWorkerSet(
+                config["env_spec"], config.get("env_config"), hidden,
+                self.sigma, n_workers, seed)
+        else:
+            self.local_worker = ESRolloutWorker(
+                config["env_spec"], config.get("env_config"), hidden,
+                self.sigma, seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        n_pairs = max(1, self.episodes_per_step // 2)
+        seeds = [int(s) for s in
+                 self._rng.integers(0, 1 << 31, size=n_pairs)]
+        if self.workers is not None:
+            ws = self.workers.remote_workers
+            # one put, many readers, completion-synced (WorkerSet helper)
+            self.workers.set_weights(self.theta)
+            shards = np.array_split(np.asarray(seeds), len(ws))
+            results = api.get([
+                w.evaluate.remote([int(s) for s in shard])
+                for w, shard in zip(ws, shards) if len(shard)])
+        else:
+            self.local_worker.set_weights(self.theta)
+            results = [self.local_worker.evaluate(seeds)]
+        all_seeds = np.concatenate([r["seeds"] for r in results])
+        pos = np.concatenate([r["pos"] for r in results])
+        neg = np.concatenate([r["neg"] for r in results])
+
+        # antithetic rank weighting: rank ALL 2n returns together, then
+        # weight each eps by (rank+ - rank-) (es.py's batched_weighted_sum
+        # over compute_centered_ranks of the full return set)
+        ranks = centered_ranks(np.concatenate([pos, neg]))
+        weights = ranks[: len(pos)] - ranks[len(pos):]
+        self.theta = np.asarray(self._update(
+            jnp.asarray(self.theta), jnp.asarray(all_seeds),
+            jnp.asarray(weights, jnp.float32)))
+        self._updates_done += 1
+
+        out = {
+            "episodes_this_iter": 2 * len(all_seeds),
+            "fitness_mean": float(np.mean(np.concatenate([pos, neg]))),
+            "fitness_max": float(max(pos.max(), neg.max())),
+            "num_updates": self._updates_done,
+            "theta_norm": float(np.linalg.norm(self.theta)),
+            "time_this_iter_s": time.time() - t0,
+        }
+        return out
+
+    def compute_single_action(self, obs: np.ndarray):
+        import jax.numpy as jnp
+
+        params = unflatten_params(self.theta, self.template)
+        out = np.asarray(mlp_apply(params, jnp.asarray(obs[None, :])))[0]
+        if self._discrete:
+            return int(out.argmax())
+        bound = float(getattr(self._probe_env, "action_bound", 1.0))
+        return bound * np.tanh(out)
+
+    def get_weights(self):
+        return self.theta
+
+    def set_weights(self, weights) -> None:
+        self.theta = np.asarray(weights, np.float32)
+
+    def _sync_weights(self) -> None:
+        pass  # theta broadcasts inside training_step
+
+    def _save_extra_state(self):
+        return {"theta": self.theta, "updates_done": self._updates_done}
+
+    def _load_extra_state(self, state) -> None:
+        if not state:
+            return
+        if "theta" in state:
+            self.theta = np.asarray(state["theta"], np.float32)
+        self._updates_done = state.get("updates_done", 0)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(ES)
+        self.extra.update({"sigma": 0.05, "episodes_per_batch": 64,
+                           "l2_coeff": 0.005})
+
+    def training(self, *, sigma=None, episodes_per_batch=None,
+                 l2_coeff=None, **kwargs) -> "ESConfig":
+        super().training(**kwargs)
+        for k, v in (("sigma", sigma),
+                     ("episodes_per_batch", episodes_per_batch),
+                     ("l2_coeff", l2_coeff)):
+            if v is not None:
+                self.extra[k] = v
+        return self

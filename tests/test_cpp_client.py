@@ -51,6 +51,7 @@ class TestCppClient:
             out = rc.stdout
             assert "CONNECTED" in out
             assert "GET roundtrip=ok" in out
+            assert "DUPGET ok" in out
             assert "NAMED registered=yes" in out
             assert "WAIT ready=1 not_ready=0" in out
             assert "RESULT ABCDEF" in out

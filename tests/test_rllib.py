@@ -795,6 +795,130 @@ class TestAPPO:
         algo.stop()
 
 
+class TestES:
+    def test_learns_cartpole_gradient_free(self):
+        """Evolution strategies improves CartPole with no gradients
+        through the policy — antithetic seed-derived perturbations,
+        centered-rank weighting (es.py; the reference's
+        tuned_examples/es contract, CI-scaled)."""
+        from ray_memory_management_tpu.rllib import ESConfig
+
+        algo = (ESConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 200})
+                .rollouts(num_rollout_workers=0)
+                .training(lr=0.03, sigma=0.1, episodes_per_batch=64)
+                .debugging(seed=3)
+                .build())
+        best = 0.0
+        result = {}
+        for _ in range(25):
+            result = algo.train()
+            best = max(best, result["fitness_mean"])
+            if best > 120:
+                break
+        assert best > 60, (best, result)
+        a = algo.compute_single_action(
+            np.array([0.01, 0.0, 0.02, 0.0], np.float32))
+        assert a in (0, 1)
+        # save/restore round-trips the flat parameter vector
+        blob = algo.save()
+        theta = algo.theta.copy()
+        algo.stop()
+        algo2 = (ESConfig()
+                 .environment("CartPole",
+                              env_config={"max_episode_steps": 200})
+                 .rollouts(num_rollout_workers=0)
+                 .debugging(seed=3)
+                 .build())
+        algo2.restore(blob)
+        np.testing.assert_allclose(algo2.theta, theta)
+        algo2.stop()
+
+    def test_seed_reconstruction_matches_worker(self):
+        """The learner's jit-reconstructed perturbation equals the
+        worker's — the invariant replacing the shared noise table."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_memory_management_tpu.rllib.es import (_perturbation,
+                                                        make_es_update)
+
+        dim = 37
+        eps_np = _perturbation(1234, dim)
+        eps_jit = np.asarray(jax.random.normal(
+            jax.random.PRNGKey(1234), (dim,), dtype=jnp.float32))
+        np.testing.assert_allclose(eps_np, eps_jit)
+        # a single-seed update moves theta exactly along eps
+        update = make_es_update(lr=1.0, sigma=1.0, l2=0.0)
+        theta = np.zeros(dim, np.float32)
+        out = np.asarray(update(jnp.asarray(theta),
+                                jnp.asarray([1234]),
+                                jnp.asarray([1.0], jnp.float32)))
+        np.testing.assert_allclose(out, eps_np, rtol=1e-6)
+
+    def test_remote_workers_shard_seeds(self, rmt_start_regular):
+        from ray_memory_management_tpu.rllib import ESConfig
+
+        algo = (ESConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 50})
+                .rollouts(num_rollout_workers=2)
+                .training(episodes_per_batch=8)
+                .debugging(seed=0)
+                .build())
+        r = algo.train()
+        assert r["episodes_this_iter"] == 8
+        algo.stop()
+
+
+class TestPG:
+    def test_learns_cartpole(self):
+        """Plain REINFORCE with a value baseline improves CartPole —
+        single pass per batch, no ratio/clip (pg.py; the reference's
+        pg_tf_policy.py:31 loss)."""
+        from ray_memory_management_tpu.rllib import PGConfig
+
+        algo = (PGConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 200})
+                .rollouts(num_rollout_workers=0,
+                          rollout_fragment_length=400)
+                .training(train_batch_size=1600, lr=1e-3,
+                          entropy_coeff=0.02)
+                .debugging(seed=1)
+                .build())
+        assert algo.num_sgd_iter == 1  # PG: no trust region, one pass
+        first = None
+        best = 0.0
+        result = {}
+        for _ in range(15):
+            result = algo.train()
+            if first is None:
+                first = result["episode_reward_mean"]
+            best = max(best, result["episode_reward_mean"])
+            if best > 100:
+                break
+        assert best > max(1.5 * first, 50), (first, best, result)
+        algo.stop()
+
+    def test_a2c_preset(self):
+        from ray_memory_management_tpu.rllib import A2CConfig
+
+        algo = (A2CConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 100})
+                .rollouts(num_rollout_workers=0,
+                          rollout_fragment_length=200)
+                .training(train_batch_size=400)
+                .debugging(seed=0)
+                .build())
+        r = algo.train()
+        assert r["num_env_steps_sampled"] >= 400
+        assert "vf_loss" in r
+        algo.stop()
+
+
 class TestConnectors:
     """Env->policy transform pipeline (the reference's connector
     framework, rllib/connectors/): unit contracts per transform, state
