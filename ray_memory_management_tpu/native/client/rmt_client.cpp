@@ -160,13 +160,15 @@ PyVal PvFloat(double v) {
 PyVal PvStr(const std::string& v) {
   PyVal p; p.kind = PyVal::Kind::Str; p.s = v; return p;
 }
-PyVal PvBytes(const std::string& v) {
+PyVal PvBytes(std::string v) {
+  // by value + move: the unpickler hands in a temporary, so a large
+  // payload is materialized exactly once (no transient double-buffer)
   PyVal p;
   p.kind = PyVal::Kind::Bytes;
   if (v.size() > 4096) {
-    p.big = std::make_shared<const std::string>(v);
+    p.big = std::make_shared<const std::string>(std::move(v));
   } else {
-    p.s = v;
+    p.s = std::move(v);
   }
   return p;
 }
@@ -217,6 +219,9 @@ void PickleValue(std::string* out, const PyVal& v) {
       break;
     case PyVal::Kind::Bytes: {
       const std::string& payload = v.bytes();
+      if (payload.size() > UINT32_MAX)
+        throw ClientError(
+            "bytes payload exceeds the 4 GiB BINBYTES limit");
       out->push_back('B');  // BINBYTES (protocol 3) <LE32 len> <raw>
       PutLE32(out, uint32_t(payload.size()));
       out->append(payload);

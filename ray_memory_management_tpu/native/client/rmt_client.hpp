@@ -108,7 +108,7 @@ PyVal PvBool(bool v);
 PyVal PvInt(int64_t v);
 PyVal PvFloat(double v);
 PyVal PvStr(const std::string& v);
-PyVal PvBytes(const std::string& v);
+PyVal PvBytes(std::string v);
 PyVal PvList(std::vector<PyVal> v);
 
 // Pickle subset codec (exposed for tests).

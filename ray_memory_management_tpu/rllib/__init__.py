@@ -19,6 +19,11 @@ from .appo import APPO, APPOConfig  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentRolloutWorker,
+    MultiCartPole,
+)
 from .es import ES, ESConfig  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,

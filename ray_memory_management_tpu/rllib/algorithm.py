@@ -131,14 +131,22 @@ class Algorithm(Trainable):
         self.local_worker: Optional[RolloutWorker] = None
         gamma = config.get("gamma", 0.99)
         lam = config.get("lambda_", 0.95)
+        # a MultiAgentEnv spec swaps in the shared-policy multi-agent
+        # collector; the learner is unchanged (the fragments it emits
+        # honor the same flat-fragment contract)
+        from .multi_agent import MultiAgentEnv, MultiAgentRolloutWorker
+
+        worker_cls = (MultiAgentRolloutWorker
+                      if isinstance(probe_env, MultiAgentEnv) else
+                      RolloutWorker)
         if config.get("num_rollout_workers", 0) > 0:
             self.workers = WorkerSet(
                 config["env_spec"], config.get("env_config"),
                 config.get("hidden", (64, 64)),
                 config["num_rollout_workers"], seed, gamma, lam,
-                connectors=connectors)
+                connectors=connectors, worker_cls=worker_cls)
         else:
-            self.local_worker = RolloutWorker(
+            self.local_worker = worker_cls(
                 config["env_spec"], config.get("env_config"),
                 config.get("hidden", (64, 64)), seed, gamma, lam,
                 connectors=connectors)
@@ -146,7 +154,9 @@ class Algorithm(Trainable):
         # always warm) or a learner-side copy synced from worker 0 (see
         # _sync_connector_state) — compute_single_action must see the
         # SAME transform the policy trained with
-        if self.local_worker is not None:
+        if worker_cls is MultiAgentRolloutWorker:
+            self._infer_pipeline = build_pipeline(None)
+        elif self.local_worker is not None:
             self._infer_pipeline = self.local_worker.connectors
         else:
             self._infer_pipeline = build_pipeline(connectors)

@@ -35,6 +35,13 @@ class OffPolicyCollector:
         if _worker_context.in_worker():
             jax.config.update("jax_default_device", jax.devices("cpu")[0])
         self.env = make_env(env_spec, env_config)
+        from .multi_agent import MultiAgentEnv
+
+        if isinstance(self.env, MultiAgentEnv):
+            raise ValueError(
+                "multi-agent envs train through the on-policy algorithms "
+                "(PPO/PG/IMPALA/APPO) with the shared-policy collector; "
+                "the replay-buffer algorithms need single-agent envs")
         self.rng = np.random.default_rng(seed)
         self._obs = self.env.reset(seed=seed)
         self._episode_reward = 0.0
@@ -87,12 +94,5 @@ class OffPolicyCollector:
         }
 
     def episode_stats(self, window: int = 100) -> Dict[str, Any]:
-        rewards = self.episode_rewards[-window:]
-        lengths = self.episode_lengths[-window:]
-        return {
-            "episodes": len(self.episode_rewards),
-            "episode_reward_mean": float(np.mean(rewards)) if rewards
-            else None,
-            "episode_len_mean": float(np.mean(lengths)) if lengths
-            else None,
-        }
+        return sb.episode_stats_summary(
+            self.episode_rewards, self.episode_lengths, window)

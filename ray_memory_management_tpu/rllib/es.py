@@ -84,6 +84,12 @@ class ESRolloutWorker:
         if _worker_context.in_worker():
             jax.config.update("jax_default_device", jax.devices("cpu")[0])
         self.env = make_env(env_spec, env_config)
+        from .multi_agent import MultiAgentEnv
+
+        if isinstance(self.env, MultiAgentEnv):
+            raise ValueError(
+                "multi-agent envs train through the on-policy algorithms "
+                "(PPO/PG/IMPALA/APPO); ES evaluates single-agent episodes")
         self.sigma = sigma
         self.discrete = hasattr(self.env, "num_actions")
         out_dim = (self.env.num_actions if self.discrete
@@ -125,6 +131,7 @@ class ESRolloutWorker:
 
     def evaluate(self, seeds: List[int]) -> Dict[str, np.ndarray]:
         """One antithetic pair of episodes per seed."""
+        steps_before = sum(self.episode_lengths)
         pos = np.zeros(len(seeds), np.float32)
         neg = np.zeros(len(seeds), np.float32)
         for i, s in enumerate(seeds):
@@ -132,18 +139,12 @@ class ESRolloutWorker:
             pos[i] = self._episode(self.theta + self.sigma * eps)
             neg[i] = self._episode(self.theta - self.sigma * eps)
         return {"seeds": np.asarray(seeds, np.int64),
-                "pos": pos, "neg": neg}
+                "pos": pos, "neg": neg,
+                "steps": sum(self.episode_lengths) - steps_before}
 
     def episode_stats(self, window: int = 100) -> Dict[str, Any]:
-        rewards = self.episode_rewards[-window:]
-        lengths = self.episode_lengths[-window:]
-        return {
-            "episodes": len(self.episode_rewards),
-            "episode_reward_mean": float(np.mean(rewards)) if rewards
-            else None,
-            "episode_len_mean": float(np.mean(lengths)) if lengths
-            else None,
-        }
+        return sb.episode_stats_summary(
+            self.episode_rewards, self.episode_lengths, window)
 
 
 class _ESWorkerSet(WorkerSet):
@@ -241,6 +242,7 @@ class ES(Algorithm):
         all_seeds = np.concatenate([r["seeds"] for r in results])
         pos = np.concatenate([r["pos"] for r in results])
         neg = np.concatenate([r["neg"] for r in results])
+        self._timesteps_total += int(sum(r["steps"] for r in results))
 
         # antithetic rank weighting: rank ALL 2n returns together, then
         # weight each eps by (rank+ - rank-) (es.py's batched_weighted_sum

@@ -128,14 +128,8 @@ class RolloutWorker:
         self.connectors.set_state(state)
 
     def episode_stats(self, window: int = 100) -> Dict[str, Any]:
-        rewards = self.episode_rewards[-window:]
-        lengths = self.episode_lengths[-window:]
-        return {
-            "episodes": len(self.episode_rewards),
-            "episode_reward_mean": float(np.mean(rewards)) if rewards
-            else None,
-            "episode_len_mean": float(np.mean(lengths)) if lengths else None,
-        }
+        return sb.episode_stats_summary(
+            self.episode_rewards, self.episode_lengths, window)
 
 
 class WorkerSet:
@@ -144,8 +138,11 @@ class WorkerSet:
 
     def __init__(self, env_spec, env_config, hidden, num_workers: int,
                  seed: int, gamma: float = 0.99, lam: float = 0.95,
-                 connectors=None):
-        cls = api.remote(RolloutWorker)
+                 connectors=None, worker_cls=None):
+        # worker_cls swaps the collector while keeping the broadcast/
+        # stats plumbing (multi_agent.MultiAgentRolloutWorker plugs in
+        # here for MultiAgentEnv specs)
+        cls = api.remote(worker_cls or RolloutWorker)
         self.remote_workers = [
             cls.options(num_cpus=1).remote(
                 env_spec, env_config, hidden, seed + 1000 * (i + 1),

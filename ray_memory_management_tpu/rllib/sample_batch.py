@@ -62,3 +62,18 @@ def minibatch_indices(n: int, minibatch_size: int,
     perm = rng.permutation(n)
     for start in range(0, n - minibatch_size + 1, minibatch_size):
         yield perm[start:start + minibatch_size]
+
+
+def episode_stats_summary(episode_rewards, episode_lengths,
+                          window: int = 100):
+    """Windowed episode metrics every collector reports (the reference's
+    metrics.py summarize_episodes) — one implementation shared by the
+    on-policy, off-policy, ES, and multi-agent collectors."""
+    rewards = episode_rewards[-window:]
+    lengths = episode_lengths[-window:]
+    return {
+        "episodes": len(episode_rewards),
+        "episode_reward_mean": float(np.mean(rewards)) if rewards
+        else None,
+        "episode_len_mean": float(np.mean(lengths)) if lengths else None,
+    }
