@@ -1,0 +1,136 @@
+"""Vision Transformer (flax.linen) — the second vision family.
+
+The reference's vision story runs torchvision models inside user Train
+loops; ViT here is first-class and TPU-shaped like resnet.py: bf16
+matmul compute on the MXU with fp32 LayerNorm statistics and the fp32
+classifier head, patchify as a single strided conv (one big matmul per
+image rather than a gather), learned position embeddings, pre-norm
+encoder blocks (Dosovitskiy et al. 2020). Attention here is
+bidirectional over ~200 patch tokens, so the jnp path XLA fuses is the
+right tool (the Pallas flash kernel in ops/ pays off at the long CAUSAL
+sequences the LM path runs, not at S~200 dense).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 384
+    n_layers: int = 12
+    n_heads: int = 6
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+PRESETS: Dict[str, ViTConfig] = {
+    # standard model card sizes (ViT-S/16, ViT-B/16)
+    "vit-s16": ViTConfig(d_model=384, n_layers=12, n_heads=6),
+    "vit-b16": ViTConfig(d_model=768, n_layers=12, n_heads=12),
+    # CI-scale: 32x32 inputs, a few layers
+    "vit-tiny-test": ViTConfig(image_size=32, patch_size=8, d_model=64,
+                               n_layers=2, n_heads=4, num_classes=10),
+}
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        # pre-norm attention (fp32 LN stats, bf16 matmuls)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.SelfAttention(
+            num_heads=cfg.n_heads, dtype=cfg.dtype,
+            deterministic=True)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(cfg.d_model * cfg.mlp_ratio, dtype=cfg.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        B = images.shape[0]
+        x = images.astype(cfg.dtype)
+        # patchify = one strided conv: [B, H, W, C] -> [B, P, d_model]
+        x = nn.Conv(cfg.d_model, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, name="patch_embed")(x)
+        x = x.reshape(B, -1, cfg.d_model)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.d_model)).astype(cfg.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, cfg.n_patches + 1, cfg.d_model))
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        # classify from the CLS token; head stays fp32 for stable logits
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0].astype(jnp.float32))
+
+
+def init_vit(cfg: ViTConfig, key) -> Any:
+    model = ViT(cfg)
+    images = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    return model, model.init(key, images)["params"]
+
+
+def vit_loss_fn(model: ViT, params, batch) -> jnp.ndarray:
+    logits = model.apply({"params": params}, batch["image"])
+    labels = jax.nn.one_hot(batch["label"], logits.shape[-1])
+    return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+
+def make_vit_train_step(model: ViT, optimizer, mesh=None):
+    """One jit'd fwd+bwd+update. With a mesh, the batch is constrained
+    onto the data axes (parallel/sharding.py's batch_pspec — the resnet
+    path's dp recipe); params/opt-state are donated so training state is
+    updated in place rather than double-buffered."""
+    import optax
+
+    def step(params, opt_state, batch):
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.sharding import batch_pspec
+
+            batch = jax.lax.with_sharding_constraint(
+                batch, NamedSharding(mesh, batch_pspec(mesh)))
+        loss, grads = jax.value_and_grad(
+            lambda p: vit_loss_fn(model, p, batch))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def count_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
