@@ -330,6 +330,7 @@ class Runtime:
         self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids
         self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)
         self._pending_schedule: deque = deque()
+        self._deferred_frees: List[bytes] = []  # zero-ref batch buffer
         # lineage pinning (reference_count.h lineage refcounting): how many
         # RETAINED task records list this oid as a ref arg. A producer's
         # record/lineage can only be pruned when no downstream record still
@@ -1424,6 +1425,7 @@ class Runtime:
     def _pump(self) -> None:
         if self.pg_manager is not None:
             self.pg_manager.retry_pending()
+        self._flush_deferred_frees()
         with self._lock:
             submits = list(self._submit_q)
             self._submit_q.clear()
@@ -2449,12 +2451,42 @@ class Runtime:
             self.local_refs[oid] += 1
 
     def remove_local_ref(self, oid: bytes) -> None:
+        # zero-ref frees batch through a small deferred buffer: a driver
+        # dropping a list of refs (every `del refs` after a bulk get)
+        # fires thousands of __del__s back-to-back, and one free_objects
+        # pass over 128 ids costs a fraction of 128 single-id passes.
+        # The pump loop flushes stragglers so an idle driver still
+        # releases store memory promptly.
         with self._lock:
             self.local_refs[oid] -= 1
             if self.local_refs[oid] > 0:
                 return
             del self.local_refs[oid]
-        self.free_object(oid)
+            self._deferred_frees.append(oid)
+            if len(self._deferred_frees) < 128:
+                return
+            batch = self._take_deferred_frees_locked()
+        self.free_objects(batch)
+
+    def _take_deferred_frees_locked(self) -> List[bytes]:
+        """With self._lock held: drain the deferral buffer, SKIPPING any
+        oid that picked up a live reference since its count hit zero
+        (e.g. a cached ref handed out again, a borrowed bare-id re-pinned
+        at submission) — freeing those would drop a value a live handle
+        still expects. The synchronous pre-batching free could never see
+        this because it ran at the zero transition itself."""
+        batch = [oid for oid in self._deferred_frees
+                 if oid not in self.local_refs]
+        self._deferred_frees = []
+        return batch
+
+    def _flush_deferred_frees(self) -> None:
+        with self._lock:
+            if not self._deferred_frees:
+                return
+            batch = self._take_deferred_frees_locked()
+        if batch:
+            self.free_objects(batch)
 
     def _try_prune_record_locked(self, task_id: bytes) -> None:
         """With self._lock held: prune a terminal task's record, futures,
@@ -2778,6 +2810,10 @@ class Runtime:
     def _make_room(self, node_id: NodeID, nbytes: int) -> None:
         """Spill a node's store down so ``nbytes`` can allocate (local
         stores spill directly; remote proxies do one agent round trip)."""
+        # deferred zero-ref frees may be pinning exactly the space the
+        # caller needs (up to 128 objects of any size): release them
+        # before resorting to spilling live objects
+        self._flush_deferred_frees()
         nm = self.nodes.get(node_id)
         if nm is None:
             return

@@ -132,5 +132,5 @@ def make_vit_train_step(model: ViT, optimizer, mesh=None):
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def count_params(params) -> int:
-    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+# same tree-leaves sum the LM family exposes — one implementation
+from .gpt import count_params  # noqa: E402,F401

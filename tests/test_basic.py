@@ -160,3 +160,29 @@ def test_task_metadata_pruned_after_refs_released(rmt_start_regular):
         assert len(rt.tasks) <= tasks_before - 300
         assert len(rt.futures) <= futures_before - 300
         assert len(rt.lineage) <= 5
+
+
+def test_deferred_free_respects_repin():
+    """Zero-ref frees are deferred into a batch; an oid that picks up a
+    live reference during the deferral window must be SKIPPED at flush
+    (freeing it would drop a value a live handle still expects)."""
+    import numpy as np
+
+    rt = rmt.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        ref = rmt.put(np.arange(1000, dtype=np.float32))
+        oid = ref.binary()
+        del ref  # count -> 0: oid enters the deferral buffer
+        assert oid in rt._deferred_frees
+        # a cached handle is handed out again before any flush
+        rt.add_local_ref(oid)
+        rt._flush_deferred_frees()
+        # the value must still be alive for the re-pinned reference
+        from ray_memory_management_tpu.core.object_ref import ObjectRef
+
+        arr = rmt.get(ObjectRef(oid, owner=rt), timeout=60)
+        assert float(arr.sum()) == float(np.arange(1000).sum())
+        # and once the re-pinned handle drops, the free really happens
+        rt._flush_deferred_frees()
+    finally:
+        rmt.shutdown()
