@@ -16,6 +16,13 @@ from .connectors import (  # noqa: F401
     register_connector,
 )
 from .appo import APPO, APPOConfig  # noqa: F401
+from .bandit import (  # noqa: F401
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+    LinearDiscreteBandit,
+)
 from .cql import CQL, CQLConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
