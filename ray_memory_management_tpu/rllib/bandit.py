@@ -85,6 +85,11 @@ def make_bandit_programs(num_arms: int, dim: int, alpha: float,
     import jax
     import jax.numpy as jnp
 
+    if mode not in ("ucb", "ts"):
+        raise ValueError(
+            f"unknown bandit exploration mode {mode!r}; use 'ucb' "
+            "(LinUCB bonus) or 'ts' (Thompson posterior draw)")
+
     def init_state():
         A = jnp.tile(lam * jnp.eye(dim)[None], (num_arms, 1, 1))
         b = jnp.zeros((num_arms, dim))

@@ -21,6 +21,7 @@ from .search import (
 from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult",
     "Trainable", "FunctionTrainable", "wrap_function",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "Searcher", "RandomSearch", "TPESearch", "BasicVariantGenerator",
     "uniform", "quniform", "loguniform", "randint", "choice",

@@ -82,6 +82,49 @@ class ASHAScheduler(TrialScheduler):
         return decision
 
 
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average score falls below the median
+    of all trials' running averages at the same point in training
+    (tune/schedulers/median_stopping_rule.py — the Google Vizier rule).
+    Gentler than ASHA: no rungs, every trial gets ``grace_period`` and
+    the cut tracks the cohort continuously."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 5, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        super().__init__(metric, mode)
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # per-trial score history (in canonical higher-is-better space)
+        self._history: Dict[str, List[float]] = {}
+
+    def _running_mean(self, tid: str, upto: int) -> float:
+        # truncate at the decision step: a finished trial's converged tail
+        # must not raise the bar on a younger trial being judged at t
+        h = self._history[tid][:upto]
+        return sum(h) / len(h)
+
+    def on_trial_result(self, runner, trial, result: Dict[str, Any]) -> str:
+        import statistics
+
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        self._history.setdefault(trial.id, []).append(score)
+        if t < self.grace_period:
+            return CONTINUE
+        n_own = len(self._history[trial.id])
+        means = [self._running_mean(tid, n_own)
+                 for tid in self._history if tid != trial.id]
+        if len(means) < self.min_samples:
+            return CONTINUE  # not enough cohort evidence to cut anyone
+        if self._running_mean(trial.id, n_own) < statistics.median(means):
+            return STOP
+        return CONTINUE
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT (tune/schedulers/pbt.py): every ``perturbation_interval``
     iterations, trials in the bottom quantile clone a top-quantile trial's

@@ -127,6 +127,27 @@ def test_asha_stops_bad_trials(rmt_start_regular):
     assert min(iters[x] for x in (100.0, 200.0, 400.0)) < 16
 
 
+def test_median_stopping_halts_below_median(rmt_start_regular):
+    """Trials whose running-average falls under the cohort median stop
+    after the grace period; the best trial runs to completion
+    (schedulers.py MedianStoppingRule; the reference's Vizier rule)."""
+    rule = tune.MedianStoppingRule(metric="loss", mode="min",
+                                   grace_period=3, min_samples_required=2)
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([1.0, 50.0, 100.0, 400.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=rule, max_iterations=12,
+                                    max_concurrent_trials=2),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    iters = {r.config["x"]: len(r.metrics_history) for r in grid}
+    assert iters[1.0] == 12  # the best trial is never median-stopped
+    # the worst trials fall under the running median and halt early
+    assert min(iters[x] for x in (100.0, 400.0)) < 12
+
+
 def test_pbt_exploits_and_perturbs(rmt_start_regular, tmp_path):
     pbt = tune.PopulationBasedTraining(
         metric="score", mode="max", perturbation_interval=3,
