@@ -50,6 +50,11 @@ from .offline import (  # noqa: F401
 )
 from .pg import A2CConfig, PG, PGConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .recurrent import (  # noqa: F401
+    RecurrentPPO,
+    RecurrentPPOConfig,
+    RecurrentRolloutWorker,
+)
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .td3 import TD3, DDPGConfig, TD3Config  # noqa: F401

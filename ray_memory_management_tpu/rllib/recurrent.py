@@ -1,0 +1,437 @@
+"""Recurrent (LSTM) policies with truncated-BPTT PPO.
+
+The reference's ``use_lstm`` model option (rllib/models/catalog.py wraps
+any model in an LSTM; rllib/policy/rnn_sequencing.py chops batches into
+max_seq_len sequences with per-sequence initial states and pads them;
+appo/ppo train over those sequences). Here the recurrent path is its own
+compact stack:
+
+- one LSTM cell between an embedding MLP and the policy/value heads
+  (lstm_ac_* in this module);
+- the rollout worker carries (h, c) across env steps, RESETS it at
+  episode boundaries, and records the state at each fragment's start —
+  so a fragment plus its initial state replays exactly;
+- the learner treats each fragment as one sequence: ``lax.scan`` over
+  time re-resets the state at recorded done flags (identical to how the
+  rollout ran), vmapped over the sequence batch, so fragments ARE the
+  reference's max_seq_len sequences without any re-chopping or padding
+  (every fragment has the same length by construction);
+- PPO's clipped surrogate applies to the flattened [N*T] outputs, and
+  minibatches are drawn as SUBSETS OF SEQUENCES (never scattered
+  timesteps, which would sever the recurrence being trained).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import api
+from . import sample_batch as sb
+from .algorithm import AlgorithmConfig
+from .env import make_env
+from .models import mlp_apply, mlp_init, params_from_numpy, params_to_numpy
+from .ppo import PPO
+from .rollout_worker import WorkerSet
+
+H0 = "lstm_h0"
+C0 = "lstm_c0"
+
+
+# ------------------------------------------------------------------ model
+def lstm_ac_init(rng, obs_dim: int, num_actions: int,
+                 embed_dim: int = 64, lstm_dim: int = 64) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    k_e, k_l, k_pi, k_vf = jax.random.split(rng, 4)
+    scale = 1.0 / np.sqrt(embed_dim + lstm_dim)
+    return {
+        "embed": mlp_init(k_e, [obs_dim, embed_dim]),
+        "lstm": {
+            "w": jax.random.normal(
+                k_l, (embed_dim + lstm_dim, 4 * lstm_dim)) * scale,
+            "b": jnp.zeros((4 * lstm_dim,))
+            # forget-gate bias starts at +1 (standard trick: remember by
+            # default early in training)
+            .at[lstm_dim:2 * lstm_dim].set(1.0),
+        },
+        "pi": mlp_init(k_pi, [lstm_dim, num_actions]),
+        "vf": mlp_init(k_vf, [lstm_dim, 1]),
+    }
+
+
+def lstm_zero_state(lstm_dim: int) -> Tuple[np.ndarray, np.ndarray]:
+    z = np.zeros(lstm_dim, np.float32)
+    return z.copy(), z.copy()
+
+
+def _cell(params, x, h, c):
+    """Standard LSTM cell; gate order [i, f, g, o]."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jnp.concatenate([x, h], axis=-1) @ params["w"] + params["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def lstm_ac_step(params, obs, h, c):
+    """One step: obs [D] (or [B, D]) -> (logits, value, h', c')."""
+    import jax
+
+    x = jax.nn.tanh(mlp_apply(params["embed"], obs))
+    h, c = _cell(params["lstm"], x, h, c)
+    logits = mlp_apply(params["pi"], h)
+    value = mlp_apply(params["vf"], h)[..., 0]
+    return logits, value, h, c
+
+
+def lstm_ac_seq(params, obs_seq, dones, h0, c0):
+    """Unroll over one sequence [T, D]; the state RESETS after any step
+    flagged done, replaying exactly what the rollout worker did.
+    Returns (logits [T, A], values [T])."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, inp):
+        h, c = carry
+        obs, done = inp
+        logits, value, h, c = lstm_ac_step(params, obs, h, c)
+        mask = 1.0 - done
+        return (h * mask, c * mask), (logits, value)
+
+    _, (logits, values) = jax.lax.scan(
+        step, (h0, c0), (obs_seq, dones))
+    return logits, values
+
+
+# ---------------------------------------------------------------- rollout
+class RecurrentRolloutWorker:
+    """RolloutWorker with an LSTM policy: carries (h, c) across steps,
+    resets at episode ends, and records each fragment's initial state so
+    the learner can replay the recurrence (rnn_sequencing.py's
+    state_in columns)."""
+
+    def __init__(self, env_spec, env_config: Optional[dict],
+                 hidden, seed: int, gamma: float = 0.99,
+                 lam: float = 0.95, connectors=None,
+                 embed_dim: int = 64, lstm_dim: int = 64):
+        import jax
+
+        from .. import _worker_context
+
+        if connectors:
+            raise ValueError(
+                "connectors are not supported with recurrent policies yet")
+        if _worker_context.in_worker():
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        del hidden  # recurrent net is embed->lstm->heads, not an MLP stack
+        self.env = make_env(env_spec, env_config)
+        self.gamma = gamma
+        self.lam = lam
+        self.obs_dim = self.env.observation_dim
+        self.lstm_dim = lstm_dim
+        self.rng = np.random.default_rng(seed)
+        self._jax_key = jax.random.key(seed)
+        self.params = lstm_ac_init(
+            jax.random.key(0), self.obs_dim, self.env.num_actions,
+            embed_dim, lstm_dim)
+        self._obs = self.env.reset(seed=seed)
+        self._h, self._c = lstm_zero_state(lstm_dim)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self.episode_rewards: List[float] = []
+        self.episode_lengths: List[int] = []
+        self._step_jit = None
+
+    def ready(self) -> str:
+        return "ok"
+
+    def set_weights(self, weights) -> None:
+        self.params = params_from_numpy(weights)
+
+    def get_weights(self):
+        return params_to_numpy(self.params)
+
+    def _policy_step(self, obs, h, c, key):
+        import jax
+        import jax.numpy as jnp
+
+        if self._step_jit is None:
+            @jax.jit
+            def stepper(params, obs, h, c, key):
+                logits, value, h, c = lstm_ac_step(params, obs, h, c)
+                action = jax.random.categorical(key, logits)
+                logp = jax.nn.log_softmax(logits)[action]
+                return action, logp, value, h, c
+
+            self._step_jit = stepper
+        return self._step_jit(self.params, jnp.asarray(obs),
+                              jnp.asarray(h), jnp.asarray(c), key)
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        import jax
+
+        obs_buf = np.zeros((num_steps, self.obs_dim), np.float32)
+        act_buf = np.zeros(num_steps, np.int32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+        logp_buf = np.zeros(num_steps, np.float32)
+        val_buf = np.zeros(num_steps, np.float32)
+        h0, c0 = np.asarray(self._h), np.asarray(self._c)
+
+        for t in range(num_steps):
+            self._jax_key, sub = jax.random.split(self._jax_key)
+            action, logp, value, h, c = self._policy_step(
+                self._obs, self._h, self._c, sub)
+            a = int(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = a
+            logp_buf[t] = float(logp)
+            val_buf[t] = float(value)
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            rew_buf[t] = reward
+            done_buf[t] = float(terminated)
+            self._episode_reward += reward
+            self._episode_len += 1
+            self._h, self._c = h, c
+            if truncated and not terminated:
+                # the single-agent truncation rule: fold V(s_next) into
+                # the reward (evaluated with the CURRENT memory) and cut
+                self._jax_key, sub = jax.random.split(self._jax_key)
+                _, _, v_next, _, _ = self._policy_step(
+                    next_obs, self._h, self._c, sub)
+                rew_buf[t] += self.gamma * float(v_next)
+                done_buf[t] = 1.0
+            if terminated or truncated:
+                self.episode_rewards.append(self._episode_reward)
+                self.episode_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                next_obs = self.env.reset(
+                    seed=int(self.rng.integers(1 << 31)))
+                self._h, self._c = lstm_zero_state(self.lstm_dim)
+            self._obs = next_obs
+
+        self._jax_key, sub = jax.random.split(self._jax_key)
+        _, _, last_val, _, _ = self._policy_step(
+            self._obs, self._h, self._c, sub)
+        bootstrap = float(last_val)
+        adv, targets = sb.compute_gae(
+            rew_buf, val_buf, done_buf, bootstrap,
+            gamma=self.gamma, lam=self.lam)
+        return {
+            sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
+            sb.DONES: done_buf, sb.LOGP: logp_buf, sb.VALUES: val_buf,
+            sb.ADVANTAGES: adv, sb.TARGETS: targets,
+            sb.BOOTSTRAP: np.array([bootstrap], np.float32),
+            H0: h0[None, :], C0: c0[None, :],  # [1, lstm_dim] per fragment
+        }
+
+    def get_connector_state(self):
+        return None
+
+    def set_connector_state(self, state) -> None:
+        pass
+
+    def episode_stats(self, window: int = 100) -> Dict[str, Any]:
+        return sb.episode_stats_summary(
+            self.episode_rewards, self.episode_lengths, window)
+
+
+# ---------------------------------------------------------------- learner
+def make_recurrent_ppo_update(optimizer, clip_param: float, vf_coeff: float,
+                              entropy_coeff: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, obs, actions, old_logp, advantages, targets,
+                dones, h0, c0):
+        # obs [N, T, D]; unroll each sequence with its recorded initial
+        # state, resetting at done flags exactly as collection did
+        logits, values = jax.vmap(
+            lambda o, d, h, c: lstm_ac_seq(params, o, d, h, c)
+        )(obs, dones, h0, c0)
+        logp_all = jax.nn.log_softmax(logits)            # [N, T, A]
+        logp = jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+        ratio = jnp.exp(logp - old_logp)
+        adv = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+        pg_loss = -surrogate.mean()
+        vf_loss = jnp.square(values - targets).mean()
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "kl": (old_logp - logp).mean()}
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, old_logp, advantages,
+               targets, dones, h0, c0):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, obs, actions, old_logp, advantages, targets, dones,
+            h0, c0)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["total_loss"] = loss
+        return params, opt_state, stats
+
+    return update
+
+
+class RecurrentPPO(PPO):
+    """PPO over LSTM policies: fragments are the training sequences.
+
+    Inherits PPO's config surface; overrides the model (lstm_ac), the
+    rollout workers (RecurrentRolloutWorker), and the SGD loop (sequence
+    minibatches through the scan-based update)."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import optax
+
+        if config.get("connectors"):
+            raise ValueError(
+                "connectors are not supported with recurrent policies yet")
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.np_rng = np.random.default_rng(seed)
+        probe_env = make_env(config["env_spec"], config.get("env_config"))
+        self.obs_dim = probe_env.observation_dim
+        self.num_actions = probe_env.num_actions
+        embed_dim = config.get("embed_dim", 64)
+        self.lstm_dim = config.get("lstm_dim", 64)
+        self.params = lstm_ac_init(
+            jax.random.key(seed), self.obs_dim, self.num_actions,
+            embed_dim, self.lstm_dim)
+        self._connector_specs = None
+        gamma = config.get("gamma", 0.99)
+        lam = config.get("lambda_", 0.95)
+        self.workers = None
+        self.local_worker = None
+        worker_args = dict(embed_dim=embed_dim, lstm_dim=self.lstm_dim)
+        if config.get("num_rollout_workers", 0) > 0:
+            self.workers = WorkerSet(
+                config["env_spec"], config.get("env_config"), None,
+                config["num_rollout_workers"], seed, gamma, lam,
+                connectors=None, worker_cls=RecurrentRolloutWorker,
+                worker_kwargs=worker_args)
+        else:
+            self.local_worker = RecurrentRolloutWorker(
+                config["env_spec"], config.get("env_config"), None, seed,
+                gamma, lam, None, **worker_args)
+        self._timesteps_total = 0
+
+        self.clip_param = config.get("clip_param", 0.2)
+        self.vf_coeff = config.get("vf_loss_coeff", 0.5)
+        self.entropy_coeff = config.get("entropy_coeff", 0.01)
+        self.num_sgd_iter = config.get("num_sgd_iter", 6)
+        # minibatches are SEQUENCES per epoch, not timesteps
+        self.sgd_minibatch_seqs = config.get("sgd_minibatch_seqs", 8)
+        self.optimizer = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_recurrent_ppo_update(
+            self.optimizer, self.clip_param, self.vf_coeff,
+            self.entropy_coeff)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        fragment = self.cfg.get("rollout_fragment_length", 200)
+        target = self.cfg.get("train_batch_size", 4000)
+
+        batches: List[Dict[str, np.ndarray]] = []
+        if self.workers is not None:
+            self._sync_weights()
+            while sum(len(b[sb.ACTIONS]) for b in batches) < target:
+                batches.extend(api.get(self.workers.sample(fragment)))
+        else:
+            self.local_worker.set_weights(self.get_weights())
+            while sum(len(b[sb.ACTIONS]) for b in batches) < target:
+                batches.append(self.local_worker.sample(fragment))
+        n = sum(len(b[sb.ACTIONS]) for b in batches)
+        self._timesteps_total += n
+        sample_time = time.time() - t0
+
+        # stack fragments into [N, T, ...] sequences
+        t1 = time.time()
+        seq = {
+            k: jnp.asarray(np.stack([b[k] for b in batches]))
+            for k in (sb.OBS, sb.ACTIONS, sb.LOGP, sb.ADVANTAGES,
+                      sb.TARGETS, sb.DONES)
+        }
+        h0 = jnp.asarray(np.concatenate([b[H0] for b in batches]))
+        c0 = jnp.asarray(np.concatenate([b[C0] for b in batches]))
+        N = len(batches)
+        stats: Dict[str, Any] = {}
+        mb = min(self.sgd_minibatch_seqs, N)
+        for _epoch in range(self.num_sgd_iter):
+            # sb.minibatch_indices drops the ragged tail, matching PPO
+            # (and avoiding a second XLA compile for the odd shape)
+            for idx_np in sb.minibatch_indices(N, mb, self.np_rng):
+                idx = jnp.asarray(idx_np)
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.opt_state,
+                    seq[sb.OBS][idx], seq[sb.ACTIONS][idx],
+                    seq[sb.LOGP][idx], seq[sb.ADVANTAGES][idx],
+                    seq[sb.TARGETS][idx], seq[sb.DONES][idx],
+                    h0[idx], c0[idx])
+        learn_time = time.time() - t1
+
+        out = {k: float(v) for k, v in stats.items()}
+        out.update({
+            "num_env_steps_sampled": n,
+            "num_sequences": N,
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+        })
+        return out
+
+    def compute_single_action(self, obs: np.ndarray,
+                              state: Optional[tuple] = None):
+        """Recurrent inference: returns (action, new_state); pass the
+        state back on the next call (None = episode start)."""
+        import jax
+        import jax.numpy as jnp
+
+        if state is None:
+            state = lstm_zero_state(self.lstm_dim)
+        h, c = state
+        logits, _, h, c = lstm_ac_step(
+            self.params, jnp.asarray(obs), jnp.asarray(h), jnp.asarray(c))
+        action = int(np.asarray(jnp.argmax(logits)))
+        return action, (np.asarray(h), np.asarray(c))
+
+
+class RecurrentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(RecurrentPPO)
+        self.extra.update({"clip_param": 0.2, "vf_loss_coeff": 0.5,
+                           "entropy_coeff": 0.01, "num_sgd_iter": 6,
+                           "sgd_minibatch_seqs": 8, "embed_dim": 64,
+                           "lstm_dim": 64})
+
+    def training(self, *, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, num_sgd_iter=None,
+                 sgd_minibatch_seqs=None, embed_dim=None, lstm_dim=None,
+                 **kwargs) -> "RecurrentPPOConfig":
+        super().training(**kwargs)
+        for k, v in (("clip_param", clip_param),
+                     ("vf_loss_coeff", vf_loss_coeff),
+                     ("entropy_coeff", entropy_coeff),
+                     ("num_sgd_iter", num_sgd_iter),
+                     ("sgd_minibatch_seqs", sgd_minibatch_seqs),
+                     ("embed_dim", embed_dim), ("lstm_dim", lstm_dim)):
+            if v is not None:
+                self.extra[k] = v
+        return self

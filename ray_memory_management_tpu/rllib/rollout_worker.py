@@ -138,15 +138,16 @@ class WorkerSet:
 
     def __init__(self, env_spec, env_config, hidden, num_workers: int,
                  seed: int, gamma: float = 0.99, lam: float = 0.95,
-                 connectors=None, worker_cls=None):
+                 connectors=None, worker_cls=None, worker_kwargs=None):
         # worker_cls swaps the collector while keeping the broadcast/
-        # stats plumbing (multi_agent.MultiAgentRolloutWorker plugs in
-        # here for MultiAgentEnv specs)
+        # stats plumbing (multi_agent.MultiAgentRolloutWorker and
+        # recurrent.RecurrentRolloutWorker plug in here); worker_kwargs
+        # carries collector-specific extras (e.g. lstm dims)
         cls = api.remote(worker_cls or RolloutWorker)
         self.remote_workers = [
             cls.options(num_cpus=1).remote(
                 env_spec, env_config, hidden, seed + 1000 * (i + 1),
-                gamma, lam, connectors)
+                gamma, lam, connectors, **(worker_kwargs or {}))
             for i in range(num_workers)
         ]
         api.get([w.ready.remote() for w in self.remote_workers])

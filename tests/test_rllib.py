@@ -1188,3 +1188,121 @@ class TestBandits:
             last = algo.train()
         assert last["regret_mean"] < 0.5 * first, (first, last)
         algo.stop()
+
+
+class TestRecurrentPPO:
+    def test_scan_matches_stepwise(self):
+        """The learner's scan unroll (with done resets) reproduces the
+        rollout's step-by-step path exactly — the invariant that makes
+        fragments valid training sequences (recurrent.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_memory_management_tpu.rllib.recurrent import (
+            lstm_ac_init, lstm_ac_seq, lstm_ac_step, lstm_zero_state)
+
+        params = lstm_ac_init(jax.random.key(0), 4, 2, 16, 16)
+        T = 12
+        obs = np.asarray(
+            jax.random.normal(jax.random.key(1), (T, 4)), np.float32)
+        dones = np.zeros(T, np.float32)
+        dones[4] = 1.0  # episode boundary mid-fragment
+        h, c = lstm_zero_state(16)
+        step_logits = []
+        for t in range(T):
+            logits, _, h, c = lstm_ac_step(
+                params, jnp.asarray(obs[t]), jnp.asarray(h),
+                jnp.asarray(c))
+            step_logits.append(np.asarray(logits))
+            if dones[t]:
+                h, c = lstm_zero_state(16)
+        seq_logits, _ = lstm_ac_seq(
+            params, jnp.asarray(obs), jnp.asarray(dones),
+            *map(jnp.asarray, lstm_zero_state(16)))
+        np.testing.assert_allclose(np.stack(step_logits),
+                                   np.asarray(seq_logits), rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_learns_memory_cue_task(self):
+        """A POMDP where memory is the WHOLE task: a cue appears only at
+        t=0 and the policy is rewarded each later step for acting on it.
+        At decision time the observation is identical for both cues, so
+        a feedforward policy caps at chance (~half the max return) while
+        the LSTM carries the cue forward (the reference's use_lstm
+        contract on partially observable tasks)."""
+        from ray_memory_management_tpu.rllib import (RecurrentPPOConfig,
+                                                     register_env)
+
+        class MemoryCue:
+            """obs [cue_active, cue_value]; reward 1 per step for
+            matching the remembered cue after it disappears."""
+
+            observation_dim = 2
+            num_actions = 2
+
+            def __init__(self, length: int = 8):
+                self.length = length
+                self._rng = np.random.default_rng(0)
+                self._cue = 1
+                self._t = 0
+
+            def reset(self, seed=None):
+                if seed is not None:
+                    self._rng = np.random.default_rng(seed)
+                self._cue = int(self._rng.integers(2))
+                self._t = 0
+                return np.array([1.0, 2.0 * self._cue - 1.0], np.float32)
+
+            def step(self, action):
+                self._t += 1
+                reward = float(action == self._cue) if self._t > 1 else 0.0
+                done = self._t >= self.length
+                return (np.zeros(2, np.float32), reward, done, False, {})
+
+        register_env("MemoryCue", lambda **kw: MemoryCue(**kw))
+        algo = (RecurrentPPOConfig()
+                .environment("MemoryCue", env_config={"length": 8})
+                .rollouts(num_rollout_workers=0,
+                          rollout_fragment_length=200)
+                .training(train_batch_size=1200, lr=3e-3, num_sgd_iter=8,
+                          sgd_minibatch_seqs=3, lstm_dim=16,
+                          embed_dim=16)
+                .debugging(seed=1)
+                .build())
+        best = 0.0
+        result = {}
+        for _ in range(15):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best > 6.5:
+                break
+        # max return 7.0 (rewards at t=2..8); memoryless chance ~3.5
+        assert best > 5.0, (best, result)
+        # recurrent inference API: the cue must steer later actions
+        a0, state = algo.compute_single_action(
+            np.array([1.0, 1.0], np.float32))
+        a_pos, _ = algo.compute_single_action(
+            np.zeros(2, np.float32), state)
+        _, state_neg = algo.compute_single_action(
+            np.array([1.0, -1.0], np.float32))
+        a_neg, _ = algo.compute_single_action(
+            np.zeros(2, np.float32), state_neg)
+        assert a_pos == 1 and a_neg == 0  # memory drives the action
+        algo.stop()
+
+    def test_remote_recurrent_workers(self, rmt_start_regular):
+        from ray_memory_management_tpu.rllib import RecurrentPPOConfig
+
+        algo = (RecurrentPPOConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 50})
+                .rollouts(num_rollout_workers=2,
+                          rollout_fragment_length=100)
+                .training(train_batch_size=400, lstm_dim=16,
+                          embed_dim=16)
+                .debugging(seed=0)
+                .build())
+        r = algo.train()
+        assert r["num_env_steps_sampled"] >= 400
+        assert r["num_sequences"] >= 4
+        algo.stop()
