@@ -16,6 +16,7 @@ cluster cannot leak resources into each other's lifetime.
 from __future__ import annotations
 
 import threading
+from multiprocessing import AuthenticationError
 from multiprocessing.connection import Listener
 from typing import Any, Dict, Optional
 
@@ -98,6 +99,12 @@ class ClusterServer:
             except (OSError, EOFError):
                 if self._stop.is_set():
                     return
+                continue
+            except AuthenticationError:
+                # a wrong client authkey raises INSIDE accept()'s
+                # handshake; letting it unwind would kill this thread
+                # and brick the server for every future client. Only
+                # this exception — anything else should surface.
                 continue
             with self._conns_lock:
                 self._conns.add(conn)

@@ -77,6 +77,13 @@ class TestCppClient:
                 capture_output=True, text=True, timeout=120)
             assert rc.returncode != 0
             assert "DEMO FAILED" in rc.stderr
+            # the failed handshake must not kill the accept loop: a
+            # well-keyed client connects fine afterwards
+            from ray_memory_management_tpu.client.client import (
+                ClientBackend)
+
+            backend = ClientBackend(host, port)
+            backend.close()
         finally:
             if server is not None:
                 server.close()
