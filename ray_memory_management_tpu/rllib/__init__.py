@@ -50,6 +50,7 @@ from .offline import (  # noqa: F401
 )
 from .pg import A2CConfig, PG, PGConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .r2d2 import R2D2, R2D2Config  # noqa: F401
 from .recurrent import (  # noqa: F401
     RecurrentPPO,
     RecurrentPPOConfig,
