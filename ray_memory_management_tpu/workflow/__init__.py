@@ -13,6 +13,7 @@ from .api import (  # noqa: F401
     StepNode,
     WorkflowStepFunction,
     cancel,
+    WorkflowCancelledError,
     delete,
     get_output,
     get_status,

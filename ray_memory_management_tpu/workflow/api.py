@@ -28,6 +28,11 @@ from .. import api
 from .. import serialization as ser
 from .storage import WorkflowStorage, list_workflows
 
+class WorkflowCancelledError(RuntimeError):
+    """Raised inside a running workflow when cancel() flipped its
+    status (the reference's WorkflowCancellationError)."""
+
+
 RUNNING = "RUNNING"
 SUCCESS = "SUCCESS"
 FAILED = "FAILED"
@@ -133,8 +138,19 @@ class _Executor:
             result = self.store.load_step_result(sid)
             self._memo[sid] = result
             return result
+        # cancellation is checked at step boundaries — AFTER the memo /
+        # committed lookups (cached hits cost no status read) and again
+        # after argument resolution below: cancel() from another
+        # thread/process flips the stored status and the next dispatch
+        # aborts; committed steps stay committed for a later resume
+        if self.store.get_status() == CANCELED:
+            raise WorkflowCancelledError(self.store.workflow_id)
         args = [self.execute(a) for a in node.args]
         kwargs = {k: self.execute(v) for k, v in node.kwargs.items()}
+        # re-check AFTER argument resolution: a cancel landing while a
+        # child step ran must stop the parent from dispatching
+        if self.store.get_status() == CANCELED:
+            raise WorkflowCancelledError(self.store.workflow_id)
         t0 = time.time()
         opts = {
             "num_cpus": node.options.get("num_cpus", 1),
@@ -176,6 +192,8 @@ def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:
     store.set_output_step(dag.step_id(ex.cache))
     try:
         result = ex.execute(dag)
+    except WorkflowCancelledError:
+        raise  # status is already CANCELED; do not overwrite with FAILED
     except BaseException:
         store.set_status(FAILED)
         raise
@@ -214,6 +232,22 @@ def rerun(dag: StepNode, *, workflow_id: str) -> Any:
     return run(dag, workflow_id=workflow_id)
 
 
+def cancel(workflow_id: str) -> None:
+    """Request cancellation: the run aborts at its next step boundary
+    (in-flight steps finish; committed steps stay committed, so a later
+    ``run(dag, workflow_id=...)`` resumes past them). Only a RUNNING
+    workflow can be canceled: terminal statuses stay put (a late cancel
+    must not relabel a completed run), and an unknown id raises without
+    leaving a phantom directory behind."""
+    if workflow_id not in list_workflows():
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    store = WorkflowStorage(workflow_id)
+    status = store.get_status()
+    if status != RUNNING:
+        return  # terminal (or never-started): nothing to cancel
+    store.set_status(CANCELED)
+
+
 def get_status(workflow_id: str) -> Optional[str]:
     return WorkflowStorage(workflow_id).get_status()
 
@@ -228,10 +262,6 @@ def get_output(workflow_id: str) -> Any:
 
 def list_all() -> List[tuple]:
     return [(wid, get_status(wid)) for wid in list_workflows()]
-
-
-def cancel(workflow_id: str) -> None:
-    WorkflowStorage(workflow_id).set_status(CANCELED)
 
 
 def delete(workflow_id: str) -> None:

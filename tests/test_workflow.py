@@ -153,3 +153,61 @@ class TestAdvanced:
         t0 = time.time()
         assert workflow.run(workflow.sleep(0.2), workflow_id="w11") == 0.2
         assert time.time() - t0 >= 0.15
+
+
+def test_cancel_aborts_at_step_boundary(wf_storage, tmp_path):
+    """cancel() from another thread aborts the run at its next step
+    boundary with WorkflowCancelledError; committed steps stay committed
+    and a later run() resumes past them (the reference's cancellation
+    semantics)."""
+    import time as _time
+
+    from ray_memory_management_tpu.workflow import WorkflowCancelledError
+
+    gate = str(tmp_path / "gate")
+
+    @workflow.step
+    def slow(x):
+        import os
+        import time
+
+        open(gate, "w").write("reached")
+        time.sleep(1.0)
+        return x + 1
+
+    @workflow.step
+    def after(x):
+        return x * 10
+
+    wid = "cancel-test"
+    dag = after.step(slow.step(1))
+    fut = workflow.run_async(dag, workflow_id=wid)
+    for _ in range(200):  # wait until the first step is actually running
+        if (tmp_path / "gate").exists():
+            break
+        _time.sleep(0.05)
+    workflow.cancel(wid)
+    try:
+        fut.result(timeout=120)
+        raise AssertionError("expected cancellation")
+    except WorkflowCancelledError:
+        pass
+    assert workflow.get_status(wid) == "CANCELED"
+    # the committed first step is reused on resume; the rest completes
+    result = workflow.run(dag, workflow_id=wid)
+    assert result == 20
+    assert workflow.get_status(wid) == "SUCCESS"
+
+
+def test_cancel_unknown_workflow_raises(wf_storage):
+    with pytest.raises(ValueError):
+        workflow.cancel("never-ran")
+    # probing with a bad id must not pollute storage with a phantom dir
+    assert "never-ran" not in [w for w, _ in workflow.list_all()]
+
+
+def test_cancel_after_success_is_a_noop(wf_storage):
+    dag = double.step(add.step(1, 2))
+    assert workflow.run(dag, workflow_id="done-wf") == 6
+    workflow.cancel("done-wf")  # late cancel must not relabel the run
+    assert workflow.get_status("done-wf") == "SUCCESS"
