@@ -90,8 +90,11 @@ class TransferServer:
         try:
             # bounded handshake: a peer that never answers times out the
             # recv instead of parking this thread forever (the accept
-            # thread is already safe — it only spawns us)
-            _set_io_timeout(conn.fileno(), 10.0)
+            # thread is already safe — it only spawns us). 30s matches
+            # the client's per-operation budget: on a loaded single-core
+            # host a BURST of concurrent handshakes contends for the GIL
+            # and 10s was observed flaking a legitimate 8-way fetch.
+            _set_io_timeout(conn.fileno(), 30.0)
             deliver_challenge(conn, self._authkey)
             answer_challenge(conn, self._authkey)
             # keep a (longer) IO timeout for the serve itself: a peer that

@@ -195,9 +195,15 @@ def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:
     except WorkflowCancelledError:
         raise  # status is already CANCELED; do not overwrite with FAILED
     except BaseException:
-        store.set_status(FAILED)
+        # a cancel racing the failure keeps CANCELED (atomic transition)
+        store.transition_status(FAILED, expect={RUNNING})
         raise
-    store.set_status(SUCCESS)
+    # cancel-wins: if cancel() landed while the FINAL step ran (no later
+    # boundary existed to observe it), the caller still gets the
+    # cancellation they asked for — the committed results make a rerun
+    # complete instantly
+    if not store.transition_status(SUCCESS, expect={RUNNING}):
+        raise WorkflowCancelledError(workflow_id)
     return result
 
 
@@ -241,11 +247,10 @@ def cancel(workflow_id: str) -> None:
     leaving a phantom directory behind."""
     if workflow_id not in list_workflows():
         raise ValueError(f"no workflow {workflow_id!r} in storage")
-    store = WorkflowStorage(workflow_id)
-    status = store.get_status()
-    if status != RUNNING:
-        return  # terminal (or never-started): nothing to cancel
-    store.set_status(CANCELED)
+    # atomic RUNNING->CANCELED: a cancel racing the run's completion
+    # write must never relabel a finished workflow
+    WorkflowStorage(workflow_id).transition_status(
+        CANCELED, expect={RUNNING})
 
 
 def get_status(workflow_id: str) -> Optional[str]:

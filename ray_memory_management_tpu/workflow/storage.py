@@ -57,6 +57,22 @@ class WorkflowStorage:
         except FileNotFoundError:
             return None
 
+    def transition_status(self, to: str, expect) -> bool:
+        """Atomically move status to ``to`` iff the current status is in
+        ``expect`` (an fcntl lock serializes racing writers — e.g. a
+        cancel() racing the run's own completion write). Returns whether
+        the transition happened."""
+        import fcntl
+
+        lock_path = os.path.join(self.root, ".status.lock")
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            if self.get_status() not in expect:
+                return False
+            self._atomic_write(os.path.join(self.root, "status"),
+                               to.encode())
+            return True
+
     def set_output_step(self, step_id: str) -> None:
         self._atomic_write(os.path.join(self.root, "output"),
                            step_id.encode())
