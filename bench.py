@@ -6,18 +6,24 @@ bus-bandwidth) when a TPU is attached.
 Runs the same metrics as the reference's ``ray microbenchmark``
 (release/microbenchmark → ray_perf.py; published numbers in
 release/release_logs/2.0.0/microbenchmark.json, mirrored in BASELINE.md) on
-this runtime and prints ONE JSON line:
+this runtime. Stdout contract: up to three ``{"detail": <section>, ...}``
+JSON lines (micro_stats / scale / tpu, also written to BENCH_DETAIL.json),
+then the LAST line is the compact (<1 KB guaranteed) headline:
 
     {"metric": ..., "value": <geomean ops-ratio>, "unit": "x_baseline",
-     "vs_baseline": <same>, "tpu": {...compute numbers...}}
+     "vs_baseline": <same>, "hw": {...}, "micro": {...}, "scale": {...},
+     "tpu": {...north-star numbers...}}
 
-vs_baseline > 1.0 means this runtime beats the reference's published
-single-node numbers on the geometric mean across the metric suite. The
-``tpu`` dict carries the north-star rows BASELINE.md mandates be measured
-(the reference publishes no training throughput): single-chip TransformerLM
-tokens/s + MFU, flash-kernel speedup over the jnp reference at long S, and
-allreduce bus-bw when >1 chip is attached. Detailed per-metric rows go to
-stderr so the stdout line stays machine-parseable.
+The driver captures only a bounded tail of stdout, so everything the round
+must prove lives in that final line (round 4's single giant line outgrew
+the window and parsed as null). vs_baseline > 1.0 means this runtime beats
+the reference's published single-node numbers on the geometric mean across
+the metric suite. The ``tpu`` dict carries the north-star rows BASELINE.md
+mandates: single-chip TransformerLM MFU, flash-kernel speedup at long S,
+serve decode tokens/s, RL env-steps/s with the learner on the chip, and
+allreduce bus-bw when >1 chip is attached — live-measured when the tunnel
+is up, else merged from TPU_RESULTS.json with a stale_max_age_h stamp.
+Human-readable per-metric rows go to stderr.
 """
 
 import json
@@ -85,6 +91,12 @@ def _tpu_row(fn_name: str, kwargs: dict, timeout_s: int = 1500,
         "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
         f"from ray_memory_management_tpu.utils.tpu_bench import {fn_name}\n"
         f"r = {fn_name}(**{kwargs!r})\n"
+        # persist the measurement the moment it succeeds: the tunnel can
+        # die minutes later and take the round's evidence with it (None =
+        # a legitimate skip, e.g. allreduce single-chip — don't store it)
+        "if r is not None:\n"
+        "    from ray_memory_management_tpu.utils import tpu_results\n"
+        f"    tpu_results.record({fn_name!r}, {kwargs!r}, r)\n"
         "print('RMTBENCH ' + json.dumps(r))\n")
     err = "unknown"
     for attempt in range(retries + 1):
@@ -97,8 +109,10 @@ def _tpu_row(fn_name: str, kwargs: dict, timeout_s: int = 1500,
                                 capture_output=True, text=True,
                                 timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            err = f"row timed out after {timeout_s}s"
-            continue
+            # a timeout means the tunnel hung the backend; a same-tunnel
+            # retry would just burn another timeout_s — bail immediately
+            # and let the caller treat the tunnel as dead
+            return None, f"row timed out after {timeout_s}s"
         for line in reversed(rc.stdout.strip().splitlines()):
             if line.startswith("RMTBENCH "):
                 return json.loads(line[len("RMTBENCH "):]), None
@@ -108,13 +122,51 @@ def _tpu_row(fn_name: str, kwargs: dict, timeout_s: int = 1500,
 
 
 def _tpu_suite():
-    """TPU compute benchmarks; returns a dict for the JSON line (or None
-    off-TPU). Every row runs in its own subprocess (see _tpu_row) so a
-    wedged backend or a regression in one row still reports the others."""
-    ok, err = _tpu_available()
-    if not ok:
-        print("  tpu suite skipped: no reachable TPU", file=sys.stderr)
-        return {"error": f"no reachable TPU: {err}"}
+    """TPU compute benchmarks; returns a dict for the detail JSON.
+
+    Every row runs in its own subprocess (see _tpu_row) so a wedged
+    backend or a regression in one row still reports the others.  When
+    the tunnel is down — or a single row fails live — the row falls back
+    to the freshest persisted measurement in ``TPU_RESULTS.json`` with an
+    age stamp (``stale_rows``): stale-but-real numbers, never a silent
+    zero.  (Round 4 lost every driver-captured TPU number to one tunnel
+    flap; see utils/tpu_results.py.)"""
+    from ray_memory_management_tpu.utils import tpu_results
+
+    live, err = _tpu_available()
+    if not live:
+        print("  tpu suite: no reachable TPU; merging persisted "
+              "measurements", file=sys.stderr)
+    stale_rows = {}
+    state = {"live": live}
+
+    def fetch(fn_name, kwargs, timeout_s=1500):
+        """Live-measure a row, else fall back to the persisted freshest.
+        Returns (result, err); stale ages collect into stale_rows. A
+        timed-out row means the tunnel died mid-suite: flip live off so
+        the remaining rows go straight to the persisted store instead of
+        each burning their full timeout (hours, in aggregate)."""
+        row_err = None
+        if state["live"]:
+            r, row_err = _tpu_row(fn_name, kwargs, timeout_s=timeout_s)
+            if r is not None or row_err is None:
+                # row_err None with r None = a legitimate live skip
+                # (e.g. allreduce on a single attached chip)
+                return r, row_err
+            if "timed out" in row_err:
+                state["live"] = False
+                print("  tpu tunnel appears dead (row timeout); "
+                      "remaining rows use persisted measurements",
+                      file=sys.stderr)
+        r, age = tpu_results.freshest(fn_name, kwargs)
+        if r is not None:
+            key = tpu_results.row_key(fn_name, kwargs)
+            stale_rows[key] = round(age / 3600, 2)
+            print(f"  tpu {key}: using persisted measurement "
+                  f"({age / 3600:.1f}h old)", file=sys.stderr)
+            return r, row_err
+        return None, row_err or f"no live TPU ({err}) and no persisted row"
+
     out = {}
     last_err = None
     train_rows = [
@@ -130,7 +182,7 @@ def _tpu_suite():
                              "batch_size": 4, "bf16_params": True}),
     ]
     for tag, kw in train_rows:
-        mfu, row_err = _tpu_row("train_step_mfu", kw)
+        mfu, row_err = fetch("train_step_mfu", kw)
         if mfu is None:
             print(f"  tpu train bench {tag} failed: {row_err}",
                   file=sys.stderr)
@@ -147,7 +199,7 @@ def _tpu_suite():
             out.setdefault("train_rows", {})[tag] = {
                 "tokens_per_s": round(mfu["tokens_per_s"], 1),
                 "mfu": round(mfu["mfu"], 4)}
-    fa, row_err = _tpu_row("flash_attention_bench", {}, timeout_s=1800)
+    fa, row_err = fetch("flash_attention_bench", {}, timeout_s=1800)
     if fa is None:
         print(f"  tpu flash bench failed: {row_err}", file=sys.stderr)
         last_err = row_err
@@ -159,7 +211,7 @@ def _tpu_suite():
                 file=sys.stderr)
         out["flash_speedup"] = {
             str(S): round(d["speedup"], 2) for S, d in fa.items()}
-    sv, row_err = _tpu_row("llm_serving_bench", {}, timeout_s=2400)
+    sv, row_err = fetch("llm_serving_bench", {}, timeout_s=2400)
     if sv is None:
         print(f"  tpu serve bench failed: {row_err}", file=sys.stderr)
         last_err = row_err
@@ -175,7 +227,17 @@ def _tpu_suite():
             sv["decode_tokens_per_s"], 1)
         if ratio:
             out["serve_continuous_vs_barrier"] = round(ratio, 2)
-    bw, row_err = _tpu_row("allreduce_busbw", {}, timeout_s=900)
+    rl, row_err = fetch("rl_learner_bench", {}, timeout_s=1800)
+    if rl is None:
+        print(f"  tpu RL learner bench failed: {row_err}", file=sys.stderr)
+        last_err = row_err
+    else:
+        print(
+            f"  tpu RL learner: {rl['env_steps_per_s']:,.0f} env-steps/s"
+            f"  (learner {rl.get('learner_ms', 0):.1f} ms/update, "
+            f"{rl.get('algo', 'ppo')})", file=sys.stderr)
+        out["rl_env_steps_per_s"] = round(rl["env_steps_per_s"], 1)
+    bw, row_err = fetch("allreduce_busbw", {}, timeout_s=900)
     if bw is None and row_err is not None:
         print(f"  tpu allreduce bench failed: {row_err}", file=sys.stderr)
         last_err = row_err
@@ -187,9 +249,13 @@ def _tpu_suite():
             f"  tpu allreduce bus-bw: {bw['busbw_gbps']:.1f} GB/s "
             f"(world={bw['world']})", file=sys.stderr)
         out["allreduce_busbw_gbps"] = round(bw["busbw_gbps"], 2)
-    if not out:
-        # every row failed (e.g. the tunnel died right after the probe):
-        # keep the failure LOUD in the JSON, not a silent tpu:null
+    if stale_rows:
+        out["stale_rows_age_h"] = stale_rows
+    out["live_tunnel"] = bool(live)
+    if not any(k for k in out
+               if k not in ("stale_rows_age_h", "live_tunnel")):
+        # every row failed live AND nothing was ever persisted: keep the
+        # failure LOUD in the JSON, not a silent tpu:null
         return {"error": f"all tpu rows failed; last: {last_err}"}
     return out
 
@@ -277,24 +343,81 @@ def main() -> None:
     scale = _scale_suite()
     tpu = _tpu_suite()
 
+    # Full detail goes to a file plus its own EARLIER stdout lines; the
+    # LAST stdout line stays compact (<1 KB) so the driver's tail window
+    # always captures the headline (round 4's single giant line outgrew
+    # that window and the whole round parsed as null).
+    detail = {"micro_stats": stats, "scale": scale, "tpu": tpu}
+    import os
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=1, sort_keys=True)
+    except OSError as e:
+        print(f"  could not write {detail_path}: {e}", file=sys.stderr)
+    for section in ("micro_stats", "scale", "tpu"):
+        if detail.get(section):
+            print(json.dumps({"detail": section, **{
+                section: detail[section]}}))
+
+    print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
+                        tpu))
+
+
+def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu):
+    """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
+    JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
+    scale rows, and the TPU north-star numbers."""
     line = {
         "metric": "core runtime microbenchmark geomean "
                   f"({len(ratios)} metrics vs ray 2.0 release numbers)",
         "value": round(gm, 4),
         "unit": "x_baseline",
         "vs_baseline": round(gm, 4),
+        "hw": {"memcpy_gbps": memcpy_gbps},
     }
-    line["hw"] = {"memcpy_gbps": memcpy_gbps}
     put = results.get("single_client_put_gigabytes")
     if put and memcpy_gbps:
         line["hw"]["put_vs_memcpy_ceiling"] = round(put / memcpy_gbps, 3)
-    if stats:
-        line["micro_stats"] = stats
     if scale:
-        line["scale"] = scale
+        line["scale"] = {
+            k: scale[k] for k in
+            ("many_actors_per_s", "many_tasks_per_s", "broadcast_gbps",
+             "cross_node_gbps") if k in scale}
+    micro = {k: stats[k]["median"] for k in
+             ("single_client_tasks_sync", "single_client_tasks_async",
+              "single_client_put_gigabytes") if k in stats}
+    if micro:
+        line["micro"] = {k: round(v, 1) for k, v in micro.items()}
     if tpu:
-        line["tpu"] = tpu
-    print(json.dumps(line))
+        if "error" in tpu:
+            line["tpu"] = {"error": tpu["error"][:120]}
+        else:
+            t = {k: tpu[k] for k in
+                 ("train_mfu", "train_tokens_per_s",
+                  "serve_decode_tokens_per_s", "rl_env_steps_per_s",
+                  "live_tunnel") if k in tpu}
+            rows = tpu.get("train_rows", {})
+            for tag, d in rows.items():
+                if tag.startswith("llama-1b"):
+                    t["llama1b_mfu"] = d["mfu"]
+            fs = tpu.get("flash_speedup", {})
+            if fs:
+                best = max(fs, key=lambda s: int(s))
+                t[f"flash_speedup_{best}"] = fs[best]
+            ages = tpu.get("stale_rows_age_h")
+            if ages:
+                t["stale_max_age_h"] = max(ages.values())
+            line["tpu"] = t
+    payload = json.dumps(line)
+    if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
+        for k in ("micro", "scale"):
+            line.pop(k, None)
+            payload = json.dumps(line)
+            if len(payload) <= 1000:
+                break
+    return payload
 
 
 if __name__ == "__main__":

@@ -22,7 +22,14 @@ from .models import ac_apply
 
 
 def make_ppo_update(optimizer, clip_param: float, vf_coeff: float,
-                    entropy_coeff: float):
+                    entropy_coeff: float, donate: bool = False):
+    """Build the jit'd minibatch update.
+
+    ``donate`` hands params/opt_state buffers back to XLA so the TPU
+    learner updates in place (no HBM copy per SGD step — the pattern the
+    train-step bench uses); callers must treat the passed-in pytrees as
+    consumed. Off by default: CPU jax ignores donation with a warning.
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -47,7 +54,7 @@ def make_ppo_update(optimizer, clip_param: float, vf_coeff: float,
             "kl": (old_logp - logp).mean(),
         }
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def update(params, opt_state, obs, actions, old_logp, advantages,
                targets):
         (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -74,7 +81,8 @@ class PPO(Algorithm):
         self.opt_state = self.optimizer.init(self.params)
         self._update = make_ppo_update(
             self.optimizer, self.clip_param, self.vf_coeff,
-            self.entropy_coeff)
+            self.entropy_coeff,
+            donate=config.get("donate_learner_state", False))
 
     def training_step(self) -> Dict[str, Any]:
         import jax.numpy as jnp

@@ -1,0 +1,105 @@
+"""Persistent store for successful TPU measurements.
+
+The tunneled single-chip TPU flaps for hours at a time (observed: up
+~1.5 h, then down 5+ h in one session).  Round 4 lost its entire
+driver-captured TPU section to one such flap: every number existed only
+in a hand-written markdown file.  The fix is to make every *successful*
+chip measurement durable the moment it happens — each bench row, whether
+run by ``bench.py`` or by hand mid-session, records itself here; the
+end-of-round bench then merges the freshest row per metric with an age
+stamp, so a dead tunnel yields stale-but-real numbers instead of
+``{"error": ...}``.
+
+Analogous in spirit to the reference's release-log capture
+(``/root/reference/release/release_logs/``): measurements outlive the
+process that took them.
+
+File format (``TPU_RESULTS.json`` at the repo root): a JSON object
+mapping ``row key -> {"ts": <epoch>, "fn": ..., "kwargs": {...},
+"result": {...}}``.  The row key is ``fn_name`` plus a stable rendering
+of kwargs so e.g. different train presets each keep their freshest row.
+Writes are atomic (tempfile + rename) and tolerate concurrent writers
+via last-writer-wins per whole-file replace after a read-merge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+# Repo root = two levels above this package directory.
+_DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "TPU_RESULTS.json")
+
+
+def results_path() -> str:
+    return os.environ.get("RMT_TPU_RESULTS", _DEFAULT_PATH)
+
+
+def row_key(fn_name: str, kwargs: dict | None = None) -> str:
+    if not kwargs:
+        return fn_name
+    parts = ",".join(f"{k}={kwargs[k]!r}" for k in sorted(kwargs))
+    return f"{fn_name}({parts})"
+
+
+def load() -> dict:
+    """All persisted rows (possibly empty)."""
+    try:
+        with open(results_path()) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def record(fn_name: str, kwargs: dict | None, result: dict) -> None:
+    """Persist one successful measurement (read-merge-replace, atomic).
+
+    An fcntl lock on a sidecar file serialises concurrent writers (a
+    hand-run sweep and a bench.py row subprocess can race; without the
+    lock one of the two measurements silently vanishes). Failures to
+    persist are swallowed — recording must never break the measurement
+    that produced the data — but LOUDLY, on stderr.
+    """
+    import fcntl
+    import sys
+
+    try:
+        path = results_path()
+        with open(path + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            rows = load()
+            rows[row_key(fn_name, kwargs)] = {
+                "ts": time.time(),
+                "fn": fn_name,
+                "kwargs": kwargs or {},
+                "result": result,
+            }
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path) or ".", suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(rows, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    except Exception as e:
+        print(f"tpu_results: could not persist {fn_name} row: {e!r}",
+              file=sys.stderr)
+
+
+def freshest(fn_name: str, kwargs: dict | None = None):
+    """(result, age_seconds) for a row, or (None, None) if absent."""
+    row = load().get(row_key(fn_name, kwargs))
+    if not row:
+        return None, None
+    return row["result"], max(0.0, time.time() - row["ts"])

@@ -1,0 +1,156 @@
+"""The bench evidence chain: the driver captures only the TAIL of
+bench.py's stdout, so the last line must stay compact (<1 KB) no matter
+how many rows the suites emit, and chip measurements must survive tunnel
+flaps via the persistent TPU_RESULTS store (utils/tpu_results.py).
+
+Round 4 lost its entire machine-visible record to both failure modes at
+once (BENCH_r04.json: ``parsed: null`` + ``tpu: {error}``); these tests
+pin the fixes.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("rmt_bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bloated_inputs():
+    results = {"single_client_put_gigabytes": 9.64,
+               **{f"row_{i}": 123.4 for i in range(40)}}
+    stats = {k: {"median": 11912.5267891, "min": 10991.1877,
+                 "max": 12835.6629, "trials": 3}
+             for k in ("single_client_tasks_sync",
+                       "single_client_tasks_async",
+                       "single_client_put_gigabytes",
+                       *(f"row_{i}" for i in range(40)))}
+    ratios = {k: 3.0 for k in results}
+    scale = {"many_actors_per_s": 86.54, "many_tasks_per_s": 3635.1,
+             "many_pgs_per_s": 29890.64, "broadcast_gbps": 5.37,
+             "cross_node_gbps": 3.65, "head_peak_rss_mb": 762.6,
+             "stats": {k: {"median": 1.0, "min": 0.5, "max": 2.0}
+                       for k in range(20)}}
+    tpu = {"train_mfu": 0.532, "train_tokens_per_s": 101786.0,
+           "serve_decode_tokens_per_s": 2345.6,
+           "rl_env_steps_per_s": 98765.4,
+           "train_rows": {
+               "llama-1b S=2048": {"tokens_per_s": 17356.0,
+                                   "mfu": 0.4795},
+               "gpt2-small S=4096": {"tokens_per_s": 61818.0,
+                                     "mfu": 0.377}},
+           "flash_speedup": {"1024": 1.1, "4096": 1.8, "8192": 2.4},
+           "stale_rows_age_h": {"train_step_mfu(batch_size=16)": 5.1},
+           "live_tunnel": False}
+    return results, stats, ratios, scale, tpu
+
+
+def test_headline_line_stays_under_1kb(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    # the mandated fields the driver must see
+    assert line["vs_baseline"] == 3.02
+    assert line["hw"]["memcpy_gbps"] == 11.56
+    assert line["hw"]["put_vs_memcpy_ceiling"] == round(9.64 / 11.56, 3)
+    assert line["tpu"]["train_mfu"] == 0.532
+    assert line["tpu"]["llama1b_mfu"] == 0.4795
+    assert line["tpu"]["flash_speedup_8192"] == 2.4
+    assert line["tpu"]["serve_decode_tokens_per_s"] == 2345.6
+    assert line["tpu"]["rl_env_steps_per_s"] == 98765.4
+    assert line["tpu"]["stale_max_age_h"] == 5.1
+    assert line["scale"]["many_actors_per_s"] == 86.54
+    assert line["micro"]["single_client_tasks_async"] == 11912.5
+
+
+def test_headline_line_tpu_error_stays_loud_and_short(bench):
+    results, stats, ratios, scale, _ = _bloated_inputs()
+    payload = bench.headline_line(
+        results, stats, ratios, 3.02, 11.56, scale,
+        {"error": "no reachable TPU: " + "x" * 500})
+    assert len(payload) <= 1000
+    assert "error" in json.loads(payload)["tpu"]
+
+
+def test_tpu_results_roundtrip(tmp_path, monkeypatch):
+    from ray_memory_management_tpu.utils import tpu_results
+
+    monkeypatch.setenv("RMT_TPU_RESULTS",
+                       str(tmp_path / "TPU_RESULTS.json"))
+    assert tpu_results.load() == {}
+    assert tpu_results.freshest("train_step_mfu") == (None, None)
+    tpu_results.record("train_step_mfu", {"batch_size": 16},
+                       {"mfu": 0.532})
+    tpu_results.record("flash_attention_bench", None, {"4096": 1.8})
+    # freshest wins per distinct kwargs key
+    tpu_results.record("train_step_mfu", {"batch_size": 16},
+                       {"mfu": 0.541})
+    res, age = tpu_results.freshest("train_step_mfu", {"batch_size": 16})
+    assert res == {"mfu": 0.541}
+    assert 0 <= age < 60
+    res, _ = tpu_results.freshest("flash_attention_bench")
+    assert res == {"4096": 1.8}
+    # distinct kwargs are distinct rows
+    assert tpu_results.freshest(
+        "train_step_mfu", {"batch_size": 32}) == (None, None)
+
+
+def test_tpu_suite_merges_persisted_when_tunnel_down(
+        bench, tmp_path, monkeypatch):
+    from ray_memory_management_tpu.utils import tpu_results
+
+    monkeypatch.setenv("RMT_TPU_RESULTS",
+                       str(tmp_path / "TPU_RESULTS.json"))
+    tpu_results.record("train_step_mfu", {"batch_size": 16},
+                       {"tokens_per_s": 101786.0, "mfu": 0.532,
+                        "n_params": 162220800, "step_ms": 161.0})
+    tpu_results.record(
+        "train_step_mfu",
+        {"preset": "llama-1b", "seq_len": 2048, "batch_size": 4,
+         "bf16_params": True},
+        {"tokens_per_s": 17356.0, "mfu": 0.4795, "n_params": 839976960,
+         "step_ms": 472.0})
+    monkeypatch.setattr(bench, "_tpu_available",
+                        lambda: (False, "tunnel down (test)"))
+    out = bench._tpu_suite()
+    assert out["train_mfu"] == 0.532
+    assert out["train_rows"]["llama-1b S=2048"]["mfu"] == 0.4795
+    assert out["live_tunnel"] is False
+    assert len(out["stale_rows_age_h"]) == 2
+    assert all(a < 1 for a in out["stale_rows_age_h"].values())
+
+
+def test_tpu_suite_no_tunnel_no_rows_is_loud(bench, tmp_path, monkeypatch):
+    monkeypatch.setenv("RMT_TPU_RESULTS",
+                       str(tmp_path / "TPU_RESULTS.json"))
+    monkeypatch.setattr(bench, "_tpu_available",
+                        lambda: (False, "tunnel down (test)"))
+    out = bench._tpu_suite()
+    assert "error" in out
+
+
+def test_repo_tpu_results_seeded_from_round4_sweep():
+    """The repo-root TPU_RESULTS.json carries the round-4 manual sweep so
+    a dead tunnel at round end still yields real (stamped) numbers."""
+    from ray_memory_management_tpu.utils import tpu_results
+
+    rows = tpu_results.load()
+    res, age = tpu_results.freshest("train_step_mfu", {"batch_size": 16})
+    # well-formed, not a fixed threshold: live bench runs legitimately
+    # overwrite this row, and benchmark variance must not fail CI
+    assert res is not None and 0 < res["mfu"] <= 1
+    assert res["tokens_per_s"] > 0
+    assert rows  # non-empty
