@@ -41,6 +41,13 @@ _flag("object_store_full_timeout_s", float, 5.0,
       "nothing is spillable before raising ObjectStoreFullError (the plasma "
       "CreateRequestQueue blocks clients the same way, "
       "create_request_queue.h:32).")
+_flag("push_pressure_retry_s", float, 30.0,
+      "Total budget a pressured push to a remote store keeps retrying "
+      "(with backoff) while the sender holds its read ref. The receiver "
+      "nacks 'retryable' when transiently full instead of failing the "
+      "transfer — pressure causes slowness, never object loss (the "
+      "reference's pull-manager admission control + queued plasma "
+      "creates, pull_manager.h:47, create_request_queue.h:32).")
 _flag("max_io_workers", int, 2,
       "Concurrent spill/restore IO threads (ray_config_def.h:489; default 4).")
 _flag("object_manager_chunk_size", int, 5 * 1024 * 1024,

@@ -166,6 +166,11 @@ class NodeAgent:
                                 4 * self.config.object_manager_chunk_size)
         self._push_acct: Dict[bytes, int] = {}  # oid -> unaccounted bytes
         self._dropped_pushes: Dict[bytes, bool] = {}  # oid -> nack pending
+        # pushes whose create hit a transiently-full store: _obj_seal acks
+        # these "retryable" so the head backs off and re-pushes while its
+        # source read ref keeps the object live (admission control, never
+        # object loss — pull_manager.h:47 / create_request_queue.h:32)
+        self._full_pushes: Dict[bytes, bool] = {}
         self._obj_cond = threading.Condition()
         # frees that arrived while a push of the same object was still
         # queued/mid-flight: consumed by _obj_push/_obj_seal so the freed
@@ -315,10 +320,35 @@ class NodeAgent:
             return  # freed before this push landed: don't resurrect it
         if oid in self._push_bufs:
             return  # an identical push is mid-flight; let it finish
+        from ..exceptions import ObjectStoreFullError
+
         try:
-            self._push_bufs[oid] = self.store.create(oid, msg["size"])
+            # SHORT create budget: a pressured push nacks retryable fast
+            # (the head backs off and retries, holding its read ref)
+            # instead of parking the shared object-plane thread for the
+            # whole full-store wait
+            self._push_bufs[oid] = self.store.create(oid, msg["size"],
+                                                     timeout_s=1.0)
         except ValueError:
             pass  # already sealed in the store: ignore this push's chunks
+        except ObjectStoreFullError:
+            while len(self._full_pushes) > 4096:
+                self._full_pushes.pop(next(iter(self._full_pushes)))
+            self._full_pushes[oid] = True  # _obj_seal acks retryable
+            # nack NOW as well (the push frame carries req): the head's
+            # chunk loop aborts on the early ack instead of streaming the
+            # whole payload per retry; mark the push dropped so the recv
+            # thread discards the chunks already in flight. The seal may
+            # already be queued on this plane — _full_pushes answers it
+            # retryable too (the head ignores the duplicate ack: its
+            # request state was popped by the first one).
+            self._dropped_pushes[oid] = True
+            try:
+                self._send({
+                    "type": "push_ack", "req": msg["req"],
+                    "error": "receiver store full (retryable)"})
+            except (OSError, BrokenPipeError):
+                pass
         except Exception:  # noqa: BLE001 — store full even after waiting:
             pass  # drop the chunks; _obj_seal acks the push with an error
 
@@ -344,6 +374,7 @@ class NodeAgent:
         with self._free_mu:
             freed = self._freed_while_pushing.pop(oid, None) is not None
             if freed:
+                self._full_pushes.pop(oid, None)
                 buf = self._push_bufs.pop(oid, None)
                 if buf is not None:
                     del buf
@@ -354,10 +385,17 @@ class NodeAgent:
                 err = "object freed during push"
             elif oid in self._push_bufs:
                 del self._push_bufs[oid]
+                self._full_pushes.pop(oid, None)
                 try:
                     self.store.seal(oid)
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
+            elif self._full_pushes.pop(oid, None) is not None \
+                    and not self.store.contains(oid):
+                # transiently-full store refused the create: tell the head
+                # to back off and retry (its read ref keeps the source copy
+                # live) — pressure is slowness, never loss
+                err = "receiver store full (retryable)"
             elif not self.store.contains(oid):
                 # this push's create was refused and nobody else sealed it:
                 # acking success would poison the head's object directory
@@ -607,8 +645,14 @@ class NodeAgent:
                 pass  # chunk of a nacked push: discard without queueing
             elif t == "obj_seal" and msg["oid"] in self._dropped_pushes:
                 # the nack already went out with the obj_push's req; the
-                # seal of a dropped push just clears the marker
+                # seal of a dropped push just clears the marker — and
+                # releases the payload-budget bytes if this push was
+                # ADMITTED before being dropped (the full-store early
+                # nack drops mid-stream: without this the admitted bytes
+                # leak and the plane budget shrinks permanently)
                 self._dropped_pushes.pop(msg["oid"], None)
+                with self._obj_cond:
+                    self._obj_q_bytes -= self._push_acct.pop(msg["oid"], 0)
             elif t in ("obj_chunk", "obj_seal", "obj_pull",
                        "obj_ensure", "obj_spill"):
                 with self._obj_cond:

@@ -74,20 +74,28 @@ class NodeObjectStore:
         buf[:] = data
         self.shm.seal(object_id)
 
-    def create(self, object_id: bytes, size: int) -> memoryview:
-        return self._create_with_spill(object_id, size)
+    def create(self, object_id: bytes, size: int,
+               timeout_s: Optional[float] = None) -> memoryview:
+        """Allocate; ``timeout_s`` overrides the config full-store wait
+        budget (e.g. the agent's push handler uses a SHORT budget so a
+        pressured push nacks retryable quickly instead of parking the
+        object plane)."""
+        return self._create_with_spill(object_id, size, timeout_s)
 
     def seal(self, object_id: bytes) -> None:
         self.shm.seal(object_id)
 
-    def _create_with_spill(self, object_id: bytes, size: int) -> memoryview:
+    def _create_with_spill(self, object_id: bytes, size: int,
+                           timeout_s: Optional[float] = None) -> memoryview:
         """Allocate, spilling LRU objects on pressure — the CreateRequestQueue
         + spill fallback path (plasma create_request_queue.h:32 +
         local_object_manager.h:99). When nothing is spillable (capacity held
         by executing tasks' reader refs), waits up to
-        ``object_store_full_timeout_s`` for refs to drain rather than failing
-        a transiently-full store."""
-        timeout_s = self.config.object_store_full_timeout_s
+        ``object_store_full_timeout_s`` (or the caller's ``timeout_s``
+        override) for refs to drain rather than failing a transiently-full
+        store."""
+        if timeout_s is None:
+            timeout_s = self.config.object_store_full_timeout_s
         deadline = time.monotonic() + timeout_s
         # residency pins are a read-race grace, not a lease: under sustained
         # pressure they yield (readers that miss re-request and re-ensure),
@@ -103,8 +111,7 @@ class NodeObjectStore:
             if time.monotonic() >= deadline:
                 raise ObjectStoreFullError(
                     f"store {self.name}: cannot allocate {size} bytes within "
-                    f"{self.config.object_store_full_timeout_s:.1f}s; "
-                    f"usage={self.shm.usage()}"
+                    f"{timeout_s:.1f}s; usage={self.shm.usage()}"
                 )
             if self._spill_for(max(size, self.config.min_spilling_size)):
                 continue

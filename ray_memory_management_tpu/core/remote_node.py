@@ -112,14 +112,15 @@ class RemoteStoreProxy:
     def put_serialized(self, object_id: bytes, serialized) -> None:
         buf = bytearray(serialized.total_size)
         serialized.write_into(memoryview(buf))
-        if not self._node.push_object(object_id, memoryview(buf)):
+        ok, err = self._node.push_object(object_id, memoryview(buf))
+        if not ok:
             # raising keeps callers from registering a GCS location for an
             # object the agent never landed
             from ..exceptions import ObjectStoreFullError
 
             raise ObjectStoreFullError(
                 f"push of {object_id.hex()[:8]} to "
-                f"{self._node.hostname} failed")
+                f"{self._node.hostname} failed ({err})")
 
     def usage(self):
         return (0, 0)
@@ -214,21 +215,26 @@ class RemoteNodeManager(NodeManager):
         return b"".join(state["chunks"])
 
     def push_object(self, object_id: bytes, view: memoryview,
-                    timeout: float = 120.0) -> bool:
-        """Chunked push (ObjectManager::Push analog). A push the agent
-        nacks under payload-budget backpressure (its admission control
-        nacks rather than parking its recv loop) is retried here with
-        backoff — congestion is transient by construction: the plane
-        drains as the store frees."""
+                    timeout: float = 120.0):
+        """Chunked push (ObjectManager::Push analog); returns
+        ``(ok, last_error)``. A push the agent nacks as retryable —
+        payload-budget backpressure from its admission control, or a
+        transiently-full store (readers still draining) — is retried
+        here with backoff for up to ``push_pressure_retry_s``: the
+        caller holds a read ref on the source copy the whole time, so
+        pressure delays the transfer but can never lose the object."""
         backoff = 0.2
+        deadline = time.monotonic() + self.config.push_pressure_retry_s
         while True:
             ok, err = self._push_object_once(object_id, view, timeout)
             if ok or not self.alive:
-                return ok
-            if not (err and "retryable" in err) or backoff > 4.0:
-                return False
+                return ok, err
+            if not (err and "retryable" in err):
+                return False, err
+            if time.monotonic() >= deadline:
+                return False, err
             time.sleep(backoff)
-            backoff *= 2
+            backoff = min(backoff * 2, 1.0)
 
     def _push_object_once(self, object_id: bytes, view: memoryview,
                           timeout: float):

@@ -1252,26 +1252,56 @@ class Runtime:
             return True
 
         def do_transfers():
-            done = 0
+            lost = None
+            degraded = []
+            for oid, src in to_fetch:
+                try:
+                    self._transfer_object(oid, src, node_id)
+                except Exception as e:  # noqa: BLE001
+                    # A failed or backpressured prefetch must never fail
+                    # the task while the object is still live somewhere:
+                    # the worker's own arg fetch (get_objects ->
+                    # _serve_get) re-transfers, restores from spill, or
+                    # serves the bytes inline as its last resort. Only a
+                    # genuinely lost object goes to lineage recovery.
+                    if self._object_alive(oid):
+                        degraded.append((oid, e))
+                    elif lost is None:
+                        lost = (oid, e)
+                finally:
+                    with self._lock:
+                        self._xfer_dec_locked(src)
+            if lost is not None:
+                # recovery re-places the task (and fails it only when the
+                # object is unrecoverable)
+                self._recover_then_reschedule(lost[0], spec, node_id)
+                return
+            if degraded:
+                events.emit(
+                    "TRANSFER_DEGRADED",
+                    f"dispatching {spec.name} with {len(degraded)} arg(s) "
+                    f"not prefetched (first: {degraded[0][0].hex()[:8]}: "
+                    f"{degraded[0][1]!r}); worker will fetch inline",
+                    severity=events.WARNING, source="object_manager")
             try:
-                for oid, src in to_fetch:
-                    try:
-                        self._transfer_object(oid, src, node_id)
-                    finally:
-                        done += 1
-                        with self._lock:
-                            self._xfer_dec_locked(src)
                 self.nodes[node_id].submit(spec)
                 self._wakeup()
-            except Exception as e:  # transfer failed: fail the task
-                # release the counts of the never-attempted remainder
-                with self._lock:
-                    for _, src in to_fetch[done:]:
-                        self._xfer_dec_locked(src)
+            except Exception as e:  # noqa: BLE001
                 self._fail_task(spec, TaskError(spec.name, e))
 
         self._transfer_pool.submit(do_transfers)
         return False
+
+    def _object_alive(self, oid: bytes) -> bool:
+        """True while ANY live copy exists: the driver memory store, or a
+        live node's store/spill tier (GCS locations cover both — spilled
+        objects keep their node's location)."""
+        with self._lock:
+            if oid in self.memory_store:
+                return True
+        return any(
+            self.nodes.get(l) is not None and self.nodes[l].alive
+            for l in self.gcs.get_object_locations(oid))
 
     def _xfer_dec_locked(self, src: NodeID) -> None:
         n = self._xfer_serving.get(src, 1) - 1
@@ -1386,9 +1416,21 @@ class Runtime:
             raise ObjectLostError(oid.hex(), f"vanished from {src}")
         try:
             if dst_remote:
-                if not dst_nm.push_object(oid, view):
+                ok, perr = dst_nm.push_object(oid, view)
+                if not ok:
+                    # our read ref (view) kept the source copy live the
+                    # whole time — a receiver that stayed full past the
+                    # retry budget is PRESSURE, not loss; type the error
+                    # so callers degrade (inline-serve / dispatch-anyway)
+                    # instead of reporting a live object lost
+                    if perr and "retryable" in perr:
+                        raise ObjectStoreFullError(
+                            f"push of {oid.hex()[:8]} to "
+                            f"{dst_nm.hostname} backpressured past the "
+                            f"retry budget ({perr})")
                     raise ObjectLostError(
-                        oid.hex(), f"push to {dst_nm.hostname} failed")
+                        oid.hex(),
+                        f"push to {dst_nm.hostname} failed ({perr})")
             else:
                 dst_store = dst_nm.store
                 chunk = self.config.object_manager_chunk_size
@@ -1845,17 +1887,22 @@ class Runtime:
             with self._lock:
                 info.pending.append(spec)
             return
+        node_id = info.node_id
         # device-resident deps block on a worker round-trip the router
-        # itself must service — never materialize on the router thread
+        # itself must service, and a store-resident transfer can park in
+        # the pressured-push retry loop for the whole retry budget —
+        # never do either on the router thread
         with self._lock:
-            has_device_dep = any(o in self._device_locations
-                                 for o in self._ref_deps(spec))
-        if has_device_dep and \
+            blocking_dep = any(
+                o in self._device_locations
+                or (o not in self.memory_store
+                    and not self.nodes[node_id].store.contains(o))
+                for o in self._ref_deps(spec))
+        if blocking_dep and \
                 threading.current_thread() is self._router:
             self._request_pool.submit(
                 self._ensure_actor_args_then_send, info, spec)
             return
-        node_id = info.node_id
         # transfer any store-resident args to the actor's node
         for oid in self._ref_deps(spec):
             with self._lock:
@@ -1869,7 +1916,27 @@ class Runtime:
                     if l != node_id and self.nodes.get(l)
                     and self.nodes[l].alive]
             if locs:
-                self._transfer_from(oid, locs, node_id)
+                try:
+                    self._transfer_from(oid, locs, node_id)
+                except Exception as e:  # noqa: BLE001
+                    # same degrade rule as do_transfers: pressure (or a
+                    # dying source) must not fail or hang the task while
+                    # the object is live — the actor worker's own arg
+                    # fetch re-transfers or reads the bytes inline
+                    if self._object_alive(oid):
+                        events.emit(
+                            "TRANSFER_DEGRADED",
+                            f"dispatching actor task {spec.name} with "
+                            f"arg {oid.hex()[:8]} not prefetched "
+                            f"({e!r}); worker will fetch inline",
+                            severity=events.WARNING,
+                            source="object_manager")
+                        continue
+                    try:
+                        self._recover_object(oid)
+                    except Exception as re:  # noqa: BLE001
+                        self._fail_task(spec, TaskError(spec.name, re))
+                        return
             elif not self.nodes[node_id].store.contains(oid):
                 try:
                     self._recover_object(oid)

@@ -224,6 +224,94 @@ def test_push_under_pressure_remote_node():
         rmt.shutdown()
 
 
+def test_actor_args_under_pressure_remote_node():
+    """The actor-task flavor of the pressure path: big args pushed to a
+    full remote store must degrade (retry / dispatch-without-prefetch,
+    worker fetches inline) — never hang the dispatch or surface
+    ObjectLostError while the source copy is live."""
+    from ray_memory_management_tpu.config import Config
+    from ray_memory_management_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cfg = Config(object_store_memory=32 << 20)
+    rt = rmt.init(num_cpus=2, _config=cfg)
+    try:
+        remote_id = rt.add_remote_node_process(num_cpus=2)
+
+        @rmt.remote(max_restarts=0)
+        class Consumer:
+            def eat(self, arr):
+                import time as _t
+
+                _t.sleep(0.1)
+                return float(arr[0])
+
+        actors = [Consumer.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=remote_id, soft=False)).remote()
+            for _ in range(2)]
+        refs = [rmt.put(np.full(1 << 20, i, dtype=np.float64))
+                for i in range(8)]
+        outs = [actors[i % 2].eat.remote(r)
+                for i, r in enumerate(refs)]
+        assert rmt.get(outs, timeout=300) == [float(i) for i in range(8)]
+    finally:
+        rmt.shutdown()
+
+
+def test_push_under_pressure_remote_node_with_cpu_load():
+    """The same pressure scenario with the HOST itself loaded (the
+    round-4 flake: on a busy 1-CPU box the transfer/allocation budgets
+    stretched and a pressured push surfaced ObjectLostError). Pressure
+    must cause slowness, never object loss: the receiver nacks
+    retryable-full, the head retries holding its read ref, and a
+    transfer that still fails degrades to dispatch-without-prefetch
+    (the worker fetches inline). Reference behavior: pull-manager
+    admission control + queued plasma creates (pull_manager.h:47,
+    create_request_queue.h:32)."""
+    import threading
+
+    from ray_memory_management_tpu.config import Config
+    from ray_memory_management_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    stop = threading.Event()
+
+    def burn():
+        x = np.random.default_rng(0).random(200_000)
+        while not stop.is_set():
+            (x * x).sum()
+
+    loaders = [threading.Thread(target=burn, daemon=True)
+               for _ in range(3)]
+    cfg = Config(object_store_memory=32 << 20)
+    rt = rmt.init(num_cpus=2, _config=cfg)
+    try:
+        remote_id = rt.add_remote_node_process(num_cpus=2)
+
+        @rmt.remote(max_retries=0)
+        def consume(arr):
+            import time as _t
+
+            _t.sleep(0.1)  # hold the arg's reader ref under pressure
+            return float(arr[0])
+
+        for th in loaders:
+            th.start()
+        refs = [rmt.put(np.full(1 << 20, i, dtype=np.float64))
+                for i in range(8)]
+        outs = [consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=remote_id, soft=False)).remote(r)
+            for r in refs]
+        assert rmt.get(outs, timeout=300) == [float(i) for i in range(8)]
+    finally:
+        stop.set()
+        rmt.shutdown()
+
+
 def test_custom_resources():
     rt = rmt.init(num_cpus=4, resources={"widget": 2})
     try:
