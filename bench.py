@@ -251,7 +251,9 @@ def _tpu_suite():
         out["allreduce_busbw_gbps"] = round(bw["busbw_gbps"], 2)
     if stale_rows:
         out["stale_rows_age_h"] = stale_rows
-    out["live_tunnel"] = bool(live)
+    # final state, not the initial probe: a tunnel that died mid-suite
+    # must not be reported live over mostly-stale rows
+    out["live_tunnel"] = bool(state["live"])
     if not any(k for k in out
                if k not in ("stale_rows_age_h", "live_tunnel")):
         # every row failed live AND nothing was ever persisted: keep the

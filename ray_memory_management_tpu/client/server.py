@@ -28,10 +28,16 @@ from ..ids import JobID
 class _JobState:
     """Per-connection resource ledger, reclaimed on disconnect."""
 
-    __slots__ = ("job_id", "actors", "pgs", "puts", "refs", "mu", "closed")
+    __slots__ = ("job_id", "actors", "pgs", "puts", "refs", "mu", "closed",
+                 "proto_verified")
 
     def __init__(self, job_id: bytes):
         self.job_id = job_id
+        # set by the first successful versioned ping; every other verb is
+        # refused until then, so a frontend cannot skip the handshake and
+        # speak unversioned (the node-registration and transfer planes
+        # already check every handshake — this closes the client plane)
+        self.proto_verified = False
         self.actors: set = set()
         self.pgs: set = set()
         self.puts: set = set()
@@ -192,6 +198,11 @@ class ClusterServer:
 
         try:
             mtype = msg["type"]
+            if mtype != "ping" and not job.proto_verified:
+                raise ValueError(
+                    f"request {mtype!r} before the wire-protocol "
+                    "handshake: clients must ping (with their proto "
+                    "version) first")
             if mtype == "submit_task":
                 reply["return_ids"] = rt.submit_task(
                     msg["payload"], adopt_returns=False)
@@ -318,6 +329,7 @@ class ClusterServer:
                         "wire protocol mismatch: server speaks "
                         f"v{WIRE_PROTOCOL_VERSION}, client spoke "
                         f"v{proto} — upgrade the older side")
+                job.proto_verified = True
                 reply["pong"] = True
             else:
                 raise ValueError(f"unknown client request {mtype!r}")

@@ -304,7 +304,10 @@ class Reader {
 
  private:
   void Need(size_t n) {
-    if (pos_ + n > d_.size()) throw ClientError("pickle: truncated stream");
+    // overflow-safe: pos_ + n can wrap for a hostile BINBYTES8 length,
+    // which would pass the naive check and desync the parse
+    if (pos_ > d_.size() || n > d_.size() - pos_)
+      throw ClientError("pickle: truncated stream");
   }
   const std::string& d_;
   size_t pos_ = 0;
@@ -685,8 +688,17 @@ PyVal Client::Request(std::map<std::string, PyVal> msg) {
   if (it == reply.dict.end() || it->second.i != req_id)
     throw ClientError("reply req_id mismatch");
   auto err = reply.dict.find("error");
-  if (err != reply.dict.end() && !err->second.is_none())
-    throw ClientError("server error: " + ScrapePrintable(err->second.s));
+  if (err != reply.dict.end() && !err->second.is_none()) {
+    // bytes() dereferences the out-of-line 'big' storage that payloads
+    // over 4 KiB land in; .s would be empty for those and report every
+    // large serialized exception as opaque
+    const PyVal& ev = err->second;
+    const std::string& blob =
+        (ev.kind == PyVal::Kind::Bytes || ev.kind == PyVal::Kind::Str)
+            ? ev.bytes()
+            : ev.s;
+    throw ClientError("server error: " + ScrapePrintable(blob));
+  }
   return reply;
 }
 

@@ -160,7 +160,6 @@ class R2D2RolloutWorker:
         act_buf = np.zeros(seq_len, np.int32)
         rew_buf = np.zeros(seq_len, np.float32)
         done_buf = np.zeros(seq_len, np.float32)  # episode boundary
-        term_buf = np.zeros(seq_len, np.float32)  # true terminal (TD mask)
         h0, c0 = np.asarray(self._h), np.asarray(self._c)
 
         for t in range(seq_len):
@@ -174,7 +173,6 @@ class R2D2RolloutWorker:
             next_obs, reward, terminated, truncated, _ = self.env.step(a)
             rew_buf[t] = reward
             done_buf[t] = float(terminated or truncated)
-            term_buf[t] = float(terminated)
             self._episode_reward += reward
             self._episode_len += 1
             self._h, self._c = np.asarray(h), np.asarray(c)
@@ -189,7 +187,7 @@ class R2D2RolloutWorker:
             self._obs = next_obs
         return {
             sb.OBS: obs_buf, sb.ACTIONS: act_buf, sb.REWARDS: rew_buf,
-            sb.DONES: done_buf, "terminated": term_buf,
+            sb.DONES: done_buf,
             H0: h0, C0: c0,
             NEXT_OBS_LAST: np.asarray(self._obs, np.float32),
         }
@@ -205,7 +203,7 @@ def make_r2d2_update(optimizer, gamma: float, burn_in: int):
     import optax
 
     def loss_fn(params, target_params, batch):
-        def per_seq(obs, actions, rewards, dones, terms, h0, c0,
+        def per_seq(obs, actions, rewards, dones, h0, c0,
                     next_last):
             # burn-in: warm the state with NO gradient (the stored h0
             # is stale relative to current params; r2d2_tf_policy.py:113).
@@ -251,8 +249,10 @@ def make_r2d2_update(optimizer, gamma: float, burn_in: int):
             # episode's target. For true terminals that is exact; for
             # time-limit truncations it under-bootstraps (the classic
             # DQN bias), which beats bootstrapping across episodes.
+            # (Per-kind handling would need a per-step next_obs column —
+            # the reset overwrites the truncated step's true successor —
+            # so the sequence schema records boundaries only.)
             boundary = dones_t
-            del terms  # recorded for future per-kind handling
             q_taken = jnp.take_along_axis(
                 q_online, acts[:, None], axis=-1)[:, 0]
             next_a = jnp.argmax(next_online, axis=-1)
@@ -365,7 +365,6 @@ class R2D2(Algorithm):
                 batch = (
                     jnp.asarray(mb[sb.OBS]), jnp.asarray(mb[sb.ACTIONS]),
                     jnp.asarray(mb[sb.REWARDS]), jnp.asarray(mb[sb.DONES]),
-                    jnp.asarray(mb["terminated"]),
                     jnp.asarray(mb[H0]), jnp.asarray(mb[C0]),
                     jnp.asarray(mb[NEXT_OBS_LAST]))
                 self.params, self.opt_state, stats = self._update(

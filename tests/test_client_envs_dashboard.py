@@ -155,6 +155,43 @@ print("CLIENT OK")
         assert "CLIENT OK" in out.stdout, out.stderr
         server.close()
 
+    def test_unversioned_requests_refused_before_ping(
+            self, rmt_start_regular):
+        """Every verb before the versioned ping handshake is refused — a
+        frontend cannot skip the ping and speak unversioned (ADVICE r4:
+        the check previously lived only in the ping handler)."""
+        from multiprocessing.connection import Client as MpClient
+
+        server = ClusterServer(port=0)
+        try:
+            conn = MpClient(("127.0.0.1", server.port), family="AF_INET",
+                            authkey=b"rmt-client")
+            try:
+                conn.send({"type": "put_bytes", "data": b"x",
+                           "req_id": 1})
+                reply = conn.recv()
+                assert reply["error"] is not None
+                from ray_memory_management_tpu import serialization as ser
+
+                exc = ser.loads(reply["error"])
+                assert "handshake" in str(exc)
+                # after a good ping the same verb works
+                from ray_memory_management_tpu.config import (
+                    WIRE_PROTOCOL_VERSION,
+                )
+
+                conn.send({"type": "ping",
+                           "proto": WIRE_PROTOCOL_VERSION, "req_id": 2})
+                assert conn.recv()["error"] is None
+                conn.send({"type": "put_bytes", "data": b"x",
+                           "req_id": 3})
+                reply = conn.recv()
+                assert reply["error"] is None and reply["object_id"]
+            finally:
+                conn.close()
+        finally:
+            server.close()
+
     def test_named_actor_via_client(self, rmt_start_regular):
         @rmt.remote
         class Registry:

@@ -1449,7 +1449,6 @@ class TestR2D2:
             jnp.zeros((N, T), jnp.int32),
             jnp.ones((N, T)),
             jnp.zeros((N, T)),
-            jnp.zeros((N, T)),
             jnp.zeros((N, 8)), jnp.zeros((N, 8)),
             jax.random.normal(jax.random.key(2), (N, 2)))
         state = opt.init(params)
@@ -1468,9 +1467,9 @@ class TestR2D2:
         tail_batch = (
             obs[:, burn_in:],
             batch[1][:, burn_in:], batch[2][:, burn_in:],
-            batch[3][:, burn_in:], batch[4][:, burn_in:],
+            batch[3][:, burn_in:],
             jax.lax.stop_gradient(bh), jax.lax.stop_gradient(bc),
-            batch[7])
+            batch[6])
         p_ref, _, _ = update0(params, params, opt.init(params),
                               tail_batch)
         for a, b in zip(jax.tree_util.tree_leaves(p_shipped),
