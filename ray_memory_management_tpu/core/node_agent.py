@@ -271,6 +271,7 @@ class NodeAgent:
                                self.config)
         env.update(msg.get("env") or {})
         bootstrap = msg.get("bootstrap")
+        conda_spec = msg.get("conda")
 
         def queue_bootstrap():
             # cold spawn: hold the token and deliver it when the worker
@@ -279,10 +280,41 @@ class NodeAgent:
             with self._lock:
                 self._pending_bootstrap[wid] = bootstrap
 
-        proc = spawn_worker_process(env, self.config, bootstrap,
-                                    queue_bootstrap)
-        with self._lock:
-            self._worker_procs[wid] = proc
+        def spawn(python_exe=None):
+            proc = spawn_worker_process(env, self.config, bootstrap,
+                                        queue_bootstrap,
+                                        python_exe=python_exe)
+            with self._lock:
+                self._worker_procs[wid] = proc
+
+        if conda_spec is None:
+            spawn()
+            return
+
+        def resolve_and_spawn():
+            # conda resolution/creation can take minutes: never on the
+            # recv loop. On failure the head must LEARN the worker died —
+            # no process ever exists, so the reap loop can't see it: send
+            # the wdeath explicitly (the event says why).
+            try:
+                from .. import runtime_env as re_mod
+
+                spawn(python_exe=re_mod.conda_python(conda_spec))
+            except Exception as e:  # noqa: BLE001
+                from ..utils import events
+
+                events.emit(
+                    "CONDA_ENV_FAILED",
+                    f"conda env {conda_spec!r} unavailable on "
+                    f"{self.node_id.hex()[:8]}: {e!r}",
+                    severity=events.ERROR, source="node_agent")
+                try:
+                    self._send({"type": "wdeath", "wid": wid})
+                except (OSError, BrokenPipeError):
+                    pass
+
+        threading.Thread(target=resolve_and_spawn, daemon=True,
+                         name=f"conda-spawn-{wid_hex[:6]}").start()
 
     def _reap_loop(self) -> None:
         """Detect workers that die WITHOUT ever dialing in (import error,

@@ -36,7 +36,7 @@ class WorkerHandle:
                  "known_fns", "known_classes", "actor_id", "inflight",
                  "lease_resources", "visible_chips", "pending_msgs",
                  "death_processed", "send_lock", "steal_pending",
-                 "re_inflight", "_alive_checked_at")
+                 "re_inflight", "conda_key", "_alive_checked_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -54,6 +54,10 @@ class WorkerHandle:
         self.known_fns: Set[bytes] = set()
         self.known_classes: Set[bytes] = set()
         self.actor_id: Optional[bytes] = None  # dedicated actor worker
+        # set when this worker's process IS a conda env's python: it only
+        # serves tasks carrying the same env key (worker_pool.h:446
+        # dedicated runtime-env workers)
+        self.conda_key: Optional[str] = None
         self.inflight: Dict[bytes, TaskSpec] = {}  # task_id -> spec
         self.re_inflight = 0  # inflight tasks carrying a runtime_env
         self.lease_resources: Optional[Resources] = None
@@ -144,7 +148,8 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
 
 def spawn_worker_process(env: Dict[str, str], config: Config,
                          bootstrap: Optional[dict] = None,
-                         on_cold_bootstrap=None):
+                         on_cold_bootstrap=None,
+                         python_exe: Optional[str] = None):
     """Start one worker process: forked from the warm zygote when the
     worker is CPU-platform (ms instead of a cold interpreter), else — and
     whenever the zygote is unavailable — a fresh ``subprocess.Popen``.
@@ -158,7 +163,8 @@ def spawn_worker_process(env: Dict[str, str], config: Config,
     ``on_cold_bootstrap`` is invoked BEFORE the process is created — the
     caller queues the message for delivery at registration, race-free
     because the worker cannot register before it exists."""
-    if config.worker_fork_server and env.get("JAX_PLATFORMS") == "cpu":
+    if python_exe is None and config.worker_fork_server \
+            and env.get("JAX_PLATFORMS") == "cpu":
         from . import zygote
 
         z = zygote.get_global()
@@ -168,8 +174,11 @@ def spawn_worker_process(env: Dict[str, str], config: Config,
                 return proc
     if bootstrap is not None and on_cold_bootstrap is not None:
         on_cold_bootstrap()
+    # python_exe: a conda env's interpreter — always a cold spawn (the
+    # zygote is the WRONG interpreter); package_env's PYTHONPATH makes
+    # this package importable from the foreign python
     return subprocess.Popen(
-        [sys.executable, "-m",
+        [python_exe or sys.executable, "-m",
          "ray_memory_management_tpu.core.worker_main"],
         env=env, close_fds=True,
     )
@@ -206,23 +215,93 @@ class NodeManager:
         self._lock = threading.RLock()
         total_chips = int(resources.total.get(TPU))
         self.free_chips: List[int] = list(range(total_chips))
+        # dedicated conda-env workers, one warm pool per env key: their
+        # process is the env's python, so they never mix with the main
+        # pool (worker_pool.h:446 dedicated runtime-env workers)
+        self.conda_idle: Dict[str, deque] = {}
+        self._conda_starting: Set[str] = set()
 
     # -- worker pool ----------------------------------------------------------
+    def start_conda_worker(self, conda_spec, conda_key: str) -> None:
+        """Spawn one dedicated worker whose process is the conda env's
+        python. Env resolution/creation can take minutes (conda env
+        create), so it runs on a daemon thread — never on the dispatch
+        path; the worker joins ``conda_idle[key]`` at registration and
+        the next dispatch round matches it."""
+        with self._lock:
+            if conda_key in self._conda_starting:
+                return
+            self._conda_starting.add(conda_key)
+
+        def resolve_and_spawn():
+            # _conda_starting holds the key until the worker REGISTERS
+            # (cleared in on_worker_ready/remove_worker) so one worker at
+            # a time starts per env; on any failure here the key clears
+            # and the failure is loud
+            handle = None
+            try:
+                from .. import runtime_env as re_mod
+
+                python_exe = re_mod.conda_python(conda_spec)
+                worker_id = WorkerID.from_random()
+                env = build_worker_env(
+                    worker_id.hex(), self.node_id.hex(), self.store_name,
+                    self.socket_path, self.authkey_hex, self.config)
+                handle = WorkerHandle(worker_id, _PendingProc(),
+                                      self.node_id)
+                handle.conda_key = conda_key
+                with self._lock:
+                    self.workers[worker_id] = handle
+                    self.starting += 1
+                self._on_worker_started(handle)
+                handle.proc = spawn_worker_process(env, self.config,
+                                                   python_exe=python_exe)
+            except Exception as e:  # noqa: BLE001
+                from ..utils import events
+
+                events.emit(
+                    "CONDA_ENV_FAILED",
+                    f"conda env {conda_spec!r} unavailable: {e!r}; "
+                    "tasks requiring it will wait",
+                    severity=events.ERROR, source="worker_pool")
+                with self._lock:
+                    self._conda_starting.discard(conda_key)
+                if handle is not None:
+                    self.remove_worker(handle)
+                return
+            if not self.alive:
+                try:
+                    handle.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=resolve_and_spawn, daemon=True,
+                         name=f"conda-spawn-{conda_key[:6]}").start()
+
     def start_worker(self, dedicated: bool = False,
                      bootstrap: Optional[dict] = None,
-                     on_handle=None) -> WorkerHandle:
+                     on_handle=None,
+                     conda_spec=None) -> WorkerHandle:
         """Spawn one worker process (WorkerPool::StartWorkerProcess analog,
         worker_pool.h:427): a worker that dials back into the runtime's
         Unix socket — the same exec-then-connect handshake the raylet uses
         with its workers (raylet_client.h:236 registration over the raylet
         socket). A ``bootstrap`` message rides the spawn itself when the
         fork path is available (startup token, worker_pool.h:446), else it
-        is queued for delivery at registration.
+        is queued for delivery at registration. ``conda_spec`` makes the
+        worker a dedicated conda-env process (cold spawn under the env's
+        python; resolution/creation may block the caller — actor creation
+        tolerates this the way it tolerates pip installs).
 
         The handle is registered — and ``on_handle`` (caller bookkeeping
         that must be visible before any reply from the worker) runs —
         BEFORE the process exists: a bootstrapped fork can answer within
         milliseconds, racing any bookkeeping done after this returns."""
+        python_exe = None
+        if conda_spec is not None:
+            from .. import runtime_env as re_mod
+
+            python_exe = re_mod.conda_python(conda_spec)
         worker_id = WorkerID.from_random()
         env = build_worker_env(worker_id.hex(), self.node_id.hex(),
                                self.store_name, self.socket_path,
@@ -247,7 +326,8 @@ class NodeManager:
             handle.pending_msgs.append(bootstrap)
 
         handle.proc = spawn_worker_process(env, self.config, bootstrap,
-                                           queue_bootstrap)
+                                           queue_bootstrap,
+                                           python_exe=python_exe)
         if not self.alive:
             # remove_node ran while we were spawning: its terminate loop
             # saw only the _PendingProc placeholder, so the real process
@@ -269,9 +349,15 @@ class NodeManager:
         with self._lock:
             handle.ready = True
             self.starting = max(0, self.starting - 1)
+            if handle.conda_key is not None:
+                self._conda_starting.discard(handle.conda_key)
             if handle.actor_id is None:
                 handle.idle = True
-                self.idle_workers.append(handle)
+                if handle.conda_key is not None:
+                    self.conda_idle.setdefault(
+                        handle.conda_key, deque()).append(handle)
+                else:
+                    self.idle_workers.append(handle)
 
     def remove_worker(self, handle: WorkerHandle) -> None:
         with self._lock:
@@ -281,6 +367,13 @@ class NodeManager:
                 self.idle_workers.remove(handle)
             except ValueError:
                 pass
+            if handle.conda_key is not None:
+                self._conda_starting.discard(handle.conda_key)
+                try:
+                    self.conda_idle.get(handle.conda_key,
+                                        deque()).remove(handle)
+                except ValueError:
+                    pass
             if not handle.ready:
                 self.starting = max(0, self.starting - 1)
             if handle.lease_resources is not None:
@@ -337,7 +430,36 @@ class NodeManager:
                 )
                 handle = None
                 lease = False
-                if req.fits_in(self.resources.available):
+                conda_spec = (spec.runtime_env or {}).get("conda") \
+                    if spec.runtime_env else None
+                if conda_spec is not None:
+                    # conda tasks only run on dedicated workers whose
+                    # process IS the env's python — never the main pool
+                    ckey = spec._conda_key
+                    if ckey is None:
+                        from .. import runtime_env as re_mod
+
+                        ckey = re_mod.conda_env_key(conda_spec)
+                        spec._conda_key = ckey
+                    if req.fits_in(self.resources.available):
+                        pool = self.conda_idle.get(ckey)
+                        while pool:
+                            cand = pool.popleft()
+                            if cand.alive() and cand.ready:
+                                handle = cand
+                                lease = True
+                                break
+                    if handle is None:
+                        # spawn ONLY when no warm worker exists for this
+                        # env (a resource wait with a warm worker must
+                        # not breed processes); resolution/creation runs
+                        # off-thread and the worker joins conda_idle at
+                        # registration (one in flight per key — the
+                        # _conda_starting guard clears at ready/death)
+                        if not self.conda_idle.get(ckey):
+                            self.start_conda_worker(conda_spec, ckey)
+                        break  # head-of-line: wait for the env worker
+                elif req.fits_in(self.resources.available):
                     while self.idle_workers:
                         cand = self.idle_workers.popleft()
                         if cand.alive() and cand.ready:
@@ -502,6 +624,11 @@ class NodeManager:
             self.busy_pool.discard(handle)
             if handle.actor_id is None and handle.alive():
                 handle.idle = True
+                if handle.conda_key is not None:
+                    # back to its env's warm dedicated pool
+                    self.conda_idle.setdefault(
+                        handle.conda_key, deque()).appendleft(handle)
+                    return
                 # LIFO: reuse the hottest worker — on small tasks this keeps
                 # one process warm (caches, branch state) and lets dispatch
                 # batches coalesce on its pipe instead of round-robining

@@ -377,9 +377,36 @@ class RemoteNodeManager(NodeManager):
             state["event"].set()
 
     # ------------------------------------------------------------ worker pool
+    def start_conda_worker(self, conda_spec, conda_key: str) -> None:
+        """Remote flavor of the dedicated conda-env worker: the env is
+        HOST-local, so the AGENT resolves/creates it and spawns under its
+        python (the head only registers the handle). Overrides the base,
+        which would Popen on the head's host against this node's
+        nonexistent local socket."""
+        with self._lock:
+            if conda_key in self._conda_starting:
+                return
+            self._conda_starting.add(conda_key)
+        worker_id = WorkerID.from_random()
+        handle = WorkerHandle(worker_id,
+                              RemoteProc(self, worker_id.binary()),
+                              self.node_id)
+        handle.conda_key = conda_key
+        with self._lock:
+            self.workers[worker_id] = handle
+            self.starting += 1
+        self._on_worker_started(handle)
+        if not self.channel_send({
+                "type": "start_worker", "wid_hex": worker_id.hex(),
+                "dedicated": False, "env": {}, "conda": conda_spec}):
+            with self._lock:
+                self._conda_starting.discard(conda_key)
+            self.remove_worker(handle)
+
     def start_worker(self, dedicated: bool = False,
                      bootstrap: Optional[dict] = None,
-                     on_handle=None) -> WorkerHandle:
+                     on_handle=None,
+                     conda_spec=None) -> WorkerHandle:
         # mirror NodeManager: register the handle and run the caller's
         # bookkeeping BEFORE the spawn frame leaves — a bootstrapped fork
         # on the agent can answer before this function returns
@@ -405,6 +432,10 @@ class RemoteNodeManager(NodeManager):
             # the agent delivers it: in-memory via its zygote fork, or on
             # the worker's dial-in if it had to cold-spawn
             msg["bootstrap"] = bootstrap
+        if conda_spec is not None:
+            # conda envs are HOST-local: the agent resolves/creates the
+            # env on its own machine and spawns under its python
+            msg["conda"] = conda_spec
         self.channel_send(msg)
         return handle
 

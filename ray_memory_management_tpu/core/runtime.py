@@ -1760,8 +1760,20 @@ class Runtime:
         # the create message is the spawn's startup token (dedicated
         # worker + assigned task, worker_pool.h:446): the fork path hands
         # it to the child in memory — no registration round trip on the
-        # actor-creation critical path
-        nm.start_worker(dedicated=True, bootstrap=msg, on_handle=on_handle)
+        # actor-creation critical path. Conda actors cold-spawn under the
+        # env's python (dedicated runtime-env worker); local resolution
+        # may block this (request-pool) thread like a pip install would.
+        conda_spec = (spec.runtime_env or {}).get("conda") \
+            if spec.runtime_env else None
+        try:
+            nm.start_worker(dedicated=True, bootstrap=msg,
+                            on_handle=on_handle, conda_spec=conda_spec)
+        except Exception as e:  # noqa: BLE001 — conda env unavailable
+            self.gcs.set_actor_state(info.record.actor_id, ACTOR_DEAD,
+                                     str(e))
+            if not info.creation_future.done():
+                info.creation_future.set_exception(ActorDiedError(str(e)))
+            self._fail_actor_queue(info, ActorDiedError(str(e)))
 
     def _on_actor_created(self, handle: WorkerHandle, msg: dict) -> None:
         actor_id = msg["actor_id"]

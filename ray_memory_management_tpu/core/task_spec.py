@@ -22,6 +22,7 @@ class TaskSpec:
         "return_ids", "resources", "strategy", "max_retries",
         "retry_exceptions", "actor_id", "method", "seq",
         "runtime_env", "placement", "depth", "_ref_deps_cache",
+        "_conda_key",
     )
 
     def __init__(
@@ -62,6 +63,9 @@ class TaskSpec:
         self.placement = placement
         self.depth = depth
         self._ref_deps_cache: Optional[List[bytes]] = None
+        # memoized conda-env key: computed once at first dispatch, not
+        # re-hashed under the node lock every dispatch round
+        self._conda_key: Optional[str] = None
 
     @property
     def ref_deps(self) -> List[bytes]:

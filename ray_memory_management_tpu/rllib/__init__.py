@@ -40,6 +40,7 @@ from .env import (  # noqa: F401
     register_env,
 )
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .qmix import QMix, QMixConfig, TwoStepCoop  # noqa: F401
 from .offline import (  # noqa: F401
     BC,
     BCConfig,

@@ -17,10 +17,21 @@ fields apply at execution time and roll back afterwards:
     dict form (``{"packages": [...], "extra_args": [...]}`` — extra_args
     is where offline installs pass ``--no-index --find-links ...``).
 
-``conda``/``container`` would need process-level isolation; they raise a
-clear error rather than silently half-working. The plugin hook mirrors
-plugin.py: a callable ``setup(env_dict) -> context_manager`` registered
-by name.
+  - ``conda``: a NAMED or CREATED conda environment. Unlike the keys
+    above, conda cannot apply inside a pooled worker (it is a different
+    interpreter): tasks and actors carrying it run in DEDICATED
+    cold-spawned workers whose process IS the env's python — the
+    reference's dedicated-worker pattern for conda/container envs
+    (worker_pool.h:446; _private/runtime_env/conda.py). Accepted forms:
+    an env name or prefix path (str), a path to an environment.yml, or
+    an env-spec dict (created once, content-keyed, via the ``conda``
+    CLI — override the binary with RMT_CONDA_EXE). The env must contain
+    this framework's dependencies (the reference likewise requires ray
+    inside the conda env).
+
+``container`` would need OS-level sandboxing; it raises a clear error
+rather than silently half-working. The plugin hook mirrors plugin.py: a
+callable ``setup(env_dict) -> context_manager`` registered by name.
 """
 
 from __future__ import annotations
@@ -35,7 +46,7 @@ import sys
 import tempfile
 from typing import Any, Callable, Dict, List, Optional
 
-_UNSUPPORTED = ("conda", "container")
+_UNSUPPORTED = ("container",)
 _plugins: Dict[str, Callable[[Any], Any]] = {}
 
 
@@ -53,8 +64,8 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
                 f"runtime_env[{key!r}] needs process-level isolation that "
                 "the pooled host-process worker model does not provide "
                 "(use 'pip' for package installs)")
-        if key not in ("env_vars", "working_dir", "py_modules", "pip") and \
-                key not in _plugins:
+        if key not in ("env_vars", "working_dir", "py_modules", "pip",
+                       "conda") and key not in _plugins:
             raise ValueError(f"unknown runtime_env key {key!r}")
     env_vars = runtime_env.get("env_vars")
     if env_vars is not None and not all(
@@ -66,6 +77,11 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         raise ValueError(
             "pip must be a list of requirements or "
             "{'packages': [...], 'extra_args': [...]}")
+    conda = runtime_env.get("conda")
+    if conda is not None and not isinstance(conda, (str, dict)):
+        raise ValueError(
+            "conda must be an env name, a prefix path, a path to an "
+            "environment.yml, or an env-spec dict")
     return dict(runtime_env)
 
 
@@ -168,6 +184,118 @@ def _pip_env_site_packages(spec) -> str:
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
     return dest
+
+
+_CONDA_CACHE = os.path.join(tempfile.gettempdir(), "rmt_runtime_env_conda")
+
+
+def _conda_exe() -> str:
+    """The conda binary: RMT_CONDA_EXE override (also how tests fake the
+    CLI), else CONDA_EXE (set inside any activated conda), else PATH."""
+    exe = os.environ.get("RMT_CONDA_EXE") or os.environ.get("CONDA_EXE") \
+        or shutil.which("conda")
+    if not exe:
+        raise RuntimeError(
+            "runtime_env['conda'] needs the conda CLI; none found "
+            "(set RMT_CONDA_EXE to the binary)")
+    return exe
+
+
+def conda_env_key(spec) -> str:
+    """Stable identity of a conda env request — the dispatch layer keys
+    dedicated workers on this (one warm dedicated pool per env, the
+    reference's runtime-env-hash worker key, worker_pool.h:446)."""
+    if isinstance(spec, str):
+        if os.path.isfile(spec):  # environment.yml: key by content
+            st = os.stat(spec)
+            raw = f"file:{os.path.abspath(spec)}:{st.st_size}:" \
+                  f"{st.st_mtime_ns}"
+        else:
+            raw = f"name:{spec}"
+    else:
+        raw = "spec:" + json.dumps(spec, sort_keys=True)
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def conda_python(spec) -> str:
+    """Resolve (creating once if needed) the env and return its python.
+
+    - prefix path with ``bin/python`` -> used directly, no CLI needed
+    - env NAME -> looked up via ``conda env list --json``
+    - environment.yml path or spec dict -> ``conda env create`` into a
+      content-keyed prefix under the host cache (created ONCE; the
+      offline-cache analog of pip's content-keyed --target dir)
+    """
+    if isinstance(spec, str):
+        cand = os.path.join(spec, "bin", "python")
+        if os.path.isdir(spec) and os.path.exists(cand):
+            return cand
+        if os.path.isfile(spec):
+            return _conda_create_keyed(yaml_path=spec)
+        # named env: ask the CLI where it lives
+        exe = _conda_exe()
+        proc = subprocess.run([exe, "env", "list", "--json"],
+                              capture_output=True, text=True)
+        if proc.returncode == 0:
+            for prefix in json.loads(proc.stdout).get("envs", []):
+                if os.path.basename(prefix) == spec:
+                    py = os.path.join(prefix, "bin", "python")
+                    if os.path.exists(py):
+                        return py
+        raise RuntimeError(
+            f"conda env {spec!r} not found (conda env list gave "
+            f"rc={proc.returncode})")
+    return _conda_create_keyed(spec_dict=spec)
+
+
+def _conda_create_keyed(spec_dict: Optional[dict] = None,
+                        yaml_path: Optional[str] = None) -> str:
+    """Create the env ONCE under a content-keyed prefix. Unlike the
+    pip/working_dir caches, conda envs are NOT relocatable (binaries and
+    activation scripts embed the prefix), so stage-and-rename would
+    corrupt them — creation happens IN PLACE at the final prefix, with
+    an fcntl lock serializing concurrent creators and a ready-marker
+    distinguishing a finished env from a half-created one (the
+    reference's conda.py locks per-env the same way,
+    _private/runtime_env/conda.py)."""
+    import fcntl
+
+    key = conda_env_key(spec_dict if spec_dict is not None else yaml_path)
+    prefix = os.path.join(_CONDA_CACHE, key)
+    py = os.path.join(prefix, "bin", "python")
+    marker = os.path.join(prefix, ".rmt_ready")
+    if os.path.exists(marker):
+        return py
+    os.makedirs(_CONDA_CACHE, exist_ok=True)
+    exe = _conda_exe()
+    with open(os.path.join(_CONDA_CACHE, f".{key}.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        if os.path.exists(marker):  # another creator finished while we waited
+            return py
+        if os.path.isdir(prefix):
+            # a previous creator died mid-create: start clean
+            shutil.rmtree(prefix, ignore_errors=True)
+        tmp = tempfile.mkdtemp(dir=_CONDA_CACHE, prefix=".spec-")
+        try:
+            if yaml_path is None:
+                # JSON is a YAML subset: dump the dict spec to a file
+                yaml_path = os.path.join(tmp, "environment.yml")
+                with open(yaml_path, "w") as f:
+                    json.dump(spec_dict, f)
+            proc = subprocess.run(
+                [exe, "env", "create", "-p", prefix, "-f", yaml_path,
+                 "--quiet"],
+                capture_output=True, text=True)
+            if proc.returncode != 0 or not os.path.exists(py):
+                shutil.rmtree(prefix, ignore_errors=True)
+                raise RuntimeError(
+                    f"conda env create failed (rc={proc.returncode}):\n"
+                    f"{proc.stderr[-2000:]}")
+            with open(marker, "w") as f:
+                f.write("ok")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return py
 
 
 def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:

@@ -25,6 +25,7 @@ from .schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from .syncer import Syncer
 from .trainable import FunctionTrainable, Trainable, wrap_function
 from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 

@@ -145,7 +145,8 @@ class Trial:
 class TrialRunner:
     def __init__(self, trainable: Tuple[str, Any],
                  trials: List[Trial], tune_config: TuneConfig,
-                 resources_per_trial: Dict[str, float]):
+                 resources_per_trial: Dict[str, float],
+                 syncer=None):
         from .. import serialization as ser
 
         self.kind, payload = trainable
@@ -153,6 +154,7 @@ class TrialRunner:
         self.trials = trials
         self.cfg = tune_config
         self.resources = resources_per_trial
+        self.syncer = syncer  # tune/syncer.py analog: cloud checkpoints
         self.scheduler = tune_config.scheduler or FIFOScheduler(
             tune_config.metric, tune_config.mode)
         cluster_cpus = int(api.cluster_resources().get("CPU", 1))
@@ -194,6 +196,21 @@ class TrialRunner:
             except Exception:
                 pass
             trial.actor = None
+        if self.syncer is not None and trial.checkpoint_blob:
+            # durability, not correctness: a failed upload must not fail
+            # the trial — but it must be LOUD (the experiment thinks its
+            # checkpoints survive the head's disk)
+            try:
+                self.syncer.upload(
+                    trial.id, trial.checkpoint_blob,
+                    iteration=trial.last_result.get("training_iteration"))
+            except Exception as e:  # noqa: BLE001
+                from ..utils import events
+
+                events.emit(
+                    "TUNE_SYNC_FAILED",
+                    f"checkpoint upload for trial {trial.id} failed: "
+                    f"{e!r}", severity=events.WARNING, source="tune")
         trial.pending_ref = None
         self.scheduler.on_trial_complete(self, trial, trial.last_result)
         if self.cfg.search_alg is not None:
@@ -309,12 +326,21 @@ class Tuner:
     def __init__(self, trainable, *, param_space: Optional[dict] = None,
                  tune_config: Optional[TuneConfig] = None,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 upload_dir: Optional[str] = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.cfg = tune_config or TuneConfig()
         self.resources = resources_per_trial or {"CPU": 1}
         self.name = name or f"tune_{int(time.time())}"
+        # cloud checkpoint sync (tune/syncer.py's upload_dir): every
+        # completed trial's checkpoint blob uploads through the external-
+        # storage registry (s3:// gs:// file:// or a registered scheme)
+        self.syncer = None
+        if upload_dir:
+            from .syncer import Syncer
+
+            self.syncer = Syncer(upload_dir, self.name)
 
     def _trainable_payload(self) -> Tuple[str, Any]:
         t = self.trainable
@@ -377,7 +403,7 @@ class Tuner:
             if not batch:
                 break
             runner = TrialRunner(payload, batch, runner_cfg,
-                                 self.resources)
+                                 self.resources, syncer=self.syncer)
             runner.run()
             for j, t in enumerate(batch):
                 alg.on_trial_complete(f"t{i + j}", t.last_result,
@@ -392,7 +418,8 @@ class Tuner:
         else:
             trials = self._generate_trials()
             runner = TrialRunner(self._trainable_payload(), trials,
-                                 self.cfg, self.resources)
+                                 self.cfg, self.resources,
+                                 syncer=self.syncer)
             runner.run()
         results = [
             TrialResult(

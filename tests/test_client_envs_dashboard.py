@@ -65,12 +65,21 @@ class TestRuntimeEnv:
         rmt.kill(a)
 
     def test_unsupported_keys_rejected(self, rmt_start_regular):
-        @rmt.remote(runtime_env={"conda": {"dependencies": ["x"]}})
+        # conda is now supported (dedicated env workers); container and
+        # unknown keys still refuse loudly
+        @rmt.remote(runtime_env={"container": {"image": "x"}})
         def nope():
             return 1
 
         with pytest.raises(ValueError):
             nope.remote()
+
+        @rmt.remote(runtime_env={"no_such_key": 1})
+        def nope2():
+            return 1
+
+        with pytest.raises(ValueError):
+            nope2.remote()
 
     def test_pip_env_installs_local_package(self, rmt_start_regular,
                                             tmp_path):
@@ -111,6 +120,125 @@ class TestRuntimeEnv:
             return "leaked"
 
         assert rmt.get(still_absent.remote(), timeout=60) == "clean"
+
+
+class TestCondaRuntimeEnv:
+    """Conda runtime envs run in DEDICATED cold workers whose process is
+    the env's python (the reference's dedicated-worker pattern for
+    conda envs, worker_pool.h:446 + _private/runtime_env/conda.py). The
+    conda CLI is faked via RMT_CONDA_EXE: creation materializes a prefix
+    whose bin/python is a wrapper stamping RMT_FAKE_CONDA_ENV before
+    exec'ing the real interpreter."""
+
+    @pytest.fixture
+    def fake_conda(self, tmp_path, monkeypatch):
+        log = tmp_path / "conda_calls.log"
+        fake = tmp_path / "conda"
+        fake.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+case "$1 $2" in
+  "env list") echo '{{"envs": []}}' ;;
+  "env create")
+    prefix=""
+    prev=""
+    for a in "$@"; do
+      if [ "$prev" = "-p" ]; then prefix="$a"; fi
+      prev="$a"
+    done
+    mkdir -p "$prefix/bin"
+    cat > "$prefix/bin/python" <<EOF
+#!/bin/sh
+export RMT_FAKE_CONDA_ENV="$prefix"
+exec {sys.executable} "\\$@"
+EOF
+    chmod +x "$prefix/bin/python"
+    ;;
+esac
+exit 0
+""")
+        fake.chmod(0o755)
+        monkeypatch.setenv("RMT_CONDA_EXE", str(fake))
+        # private content-keyed cache per test run
+        import ray_memory_management_tpu.runtime_env as re_mod
+
+        monkeypatch.setattr(re_mod, "_CONDA_CACHE",
+                            str(tmp_path / "conda_cache"))
+        return log
+
+    def test_conda_task_runs_in_env_worker(self, rmt_start_regular,
+                                           fake_conda):
+        spec = {"name": "e2e", "dependencies": ["python"]}
+
+        @rmt.remote(runtime_env={"conda": spec}, max_retries=0)
+        def where():
+            import os as _os
+
+            return _os.environ.get("RMT_FAKE_CONDA_ENV")
+
+        @rmt.remote(max_retries=0)
+        def plain():
+            import os as _os
+
+            return _os.environ.get("RMT_FAKE_CONDA_ENV")
+
+        env_prefix = rmt.get(where.remote(), timeout=120)
+        assert env_prefix and "conda_cache" in env_prefix
+        # pooled workers are untouched by the env
+        assert rmt.get(plain.remote(), timeout=60) is None
+        # offline cache: a second task reuses the created env — exactly
+        # one `env create` ever runs, and the warm dedicated worker
+        # serves the task without a new spawn
+        assert rmt.get(where.remote(), timeout=60) == env_prefix
+        creates = [ln for ln in
+                   fake_conda.read_text().splitlines()
+                   if ln.startswith("env create")]
+        assert len(creates) == 1
+
+    def test_conda_actor_runs_in_env_worker(self, rmt_start_regular,
+                                            fake_conda):
+        @rmt.remote(runtime_env={"conda": {"name": "act",
+                                           "dependencies": []}},
+                    max_restarts=0)
+        class Probe:
+            def env(self):
+                import os as _os
+
+                return _os.environ.get("RMT_FAKE_CONDA_ENV")
+
+        a = Probe.remote()
+        prefix = rmt.get(a.env.remote(), timeout=120)
+        assert prefix and "conda_cache" in prefix
+        rmt.kill(a)
+
+    def test_conda_prefix_path_used_directly(self, rmt_start_regular,
+                                             fake_conda, tmp_path):
+        # a prefix dir with bin/python skips the CLI entirely
+        prefix = tmp_path / "preexisting"
+        (prefix / "bin").mkdir(parents=True)
+        py = prefix / "bin" / "python"
+        py.write_text(f"""#!/bin/sh
+export RMT_FAKE_CONDA_ENV="{prefix}"
+exec {sys.executable} "$@"
+""")
+        py.chmod(0o755)
+
+        @rmt.remote(runtime_env={"conda": str(prefix)}, max_retries=0)
+        def where():
+            import os as _os
+
+            return _os.environ.get("RMT_FAKE_CONDA_ENV")
+
+        assert rmt.get(where.remote(), timeout=120) == str(prefix)
+        assert "env create" not in fake_conda.read_text() \
+            if fake_conda.exists() else True
+
+    def test_container_still_rejected(self, rmt_start_regular):
+        with pytest.raises(ValueError, match="container"):
+            @rmt.remote(runtime_env={"container": {"image": "x"}})
+            def f():
+                return 1
+
+            f.remote()
 
 
 class TestClientMode:

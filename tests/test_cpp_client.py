@@ -106,6 +106,14 @@ class TestCppClient:
             oid = rmt.put({"rich": "value"})
             conn = Client((host, port), family="AF_INET",
                           authkey=b"rmt-client")
+            # versioned handshake first: unversioned verbs are refused
+            from ray_memory_management_tpu.config import (
+                WIRE_PROTOCOL_VERSION,
+            )
+
+            conn.send({"type": "ping", "proto": WIRE_PROTOCOL_VERSION,
+                       "req_id": 0})
+            assert conn.recv()["error"] is None
             conn.send({"type": "get_bytes", "oids": [oid.binary()],
                        "req_id": 1, "timeout": 30})
             reply = conn.recv()

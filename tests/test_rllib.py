@@ -272,6 +272,87 @@ class TestTuneIntegration:
         assert best.metrics["training_iteration"] == 2
 
 
+class TestQMix:
+    def test_mixer_is_monotonic_in_agent_qs(self):
+        """dQ_tot/dQ_i >= 0 for every agent at random states/qs — the
+        property (abs on hypernetwork weights) that makes decentralized
+        greedy execution consistent with the centralized critic
+        (qmix_policy.py's QMixer)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_memory_management_tpu.rllib.qmix import mix, qmix_init
+
+        params = qmix_init(jax.random.key(0), obs_dim=5, num_actions=2,
+                           n_agents=3, state_dim=4, mixing_dim=8)
+        B = 16
+        state = jax.random.normal(jax.random.key(1), (B, 4))
+        qs = jax.random.normal(jax.random.key(2), (B, 3))
+        grads = jax.vmap(jax.grad(
+            lambda q, s: mix(params, s[None], q[None], 3, 8)[0]
+        ))(qs, state)
+        assert float(jnp.min(grads)) >= 0.0
+
+    def test_learns_two_step_coordination(self):
+        """The QMIX paper's two-step game: greedy independent learners
+        plateau at the safe 7-reward branch; monotonic value
+        factorization must find the coordinated 8 (threshold > 7.0)."""
+        from ray_memory_management_tpu.rllib import QMixConfig
+
+        algo = (QMixConfig()
+                .environment("TwoStepCoop")
+                .rollouts(num_rollout_workers=0,
+                          rollout_fragment_length=64)
+                .training(lr=3e-3, train_batch_size=64,
+                          learning_starts=128, updates_per_step=16,
+                          target_network_update_freq=50,
+                          epsilon_timesteps=1500, gamma=0.99)
+                .debugging(seed=3)
+                .build())
+        result = {}
+        for _ in range(40):
+            result = algo.train()
+            if (result["episode_reward_mean"] or 0) > 7.5:
+                break
+        assert result["episode_reward_mean"] > 7.0, result
+        # greedy decentralized execution coordinates on the 8 branch
+        from ray_memory_management_tpu.rllib.qmix import TwoStepCoop
+
+        env = TwoStepCoop()
+        obs = env.reset()
+        acts = algo.compute_actions(obs)
+        obs, _, _, _, _ = env.step(acts)
+        assert acts["agent_0"] == 1  # picked the risky branch
+        acts = algo.compute_actions(obs)
+        r = env.step(acts)[1]["agent_0"]
+        assert r == 8.0
+        algo.stop()
+
+    def test_checkpoint_roundtrip(self):
+        from ray_memory_management_tpu.rllib import QMixConfig
+
+        cfg = (QMixConfig()
+               .environment("TwoStepCoop")
+               .rollouts(num_rollout_workers=0,
+                         rollout_fragment_length=32)
+               .training(train_batch_size=32, learning_starts=32)
+               .debugging(seed=4))
+        algo = cfg.build()
+        algo.train()
+        blob = algo.save()
+        env2 = cfg.build()
+        env2.restore(blob)
+        import jax.tree_util as jtu
+        import numpy as np_
+
+        for a, b in zip(jtu.tree_leaves(algo.params),
+                        jtu.tree_leaves(env2.params)):
+            np_.testing.assert_array_equal(np_.asarray(a),
+                                           np_.asarray(b))
+        algo.stop()
+        env2.stop()
+
+
 class TestDQN:
     def test_learns_cartpole(self):
         """Off-policy learning regression: double-DQN with replay + target

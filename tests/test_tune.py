@@ -92,6 +92,76 @@ def test_tuner_function_trainable(rmt_start_regular):
     assert best.metrics["acc"] == pytest.approx(1.2)
 
 
+def test_tuner_cloud_checkpoint_sync(rmt_start_regular, tmp_path):
+    """Trial checkpoints sync to a gs://-style upload_dir through the
+    external-storage registry (the reference's tune/syncer.py upload_dir
+    contract), and a FRESH Syncer with no local state recovers the blob
+    from the deterministic key layout alone."""
+    from ray_memory_management_tpu.core.external_storage import (
+        FileSystemStorage, register_storage_scheme,
+    )
+
+    # a gs://-shaped URI served by a local fake: the registry maps the
+    # scheme to a filesystem-backed store rooted at tmp_path
+    root = tmp_path / "bucket"
+
+    class _FakeCloud(FileSystemStorage):
+        def __init__(self, uri):
+            assert uri.startswith("mockgs://")
+            super().__init__(str(root / uri[len("mockgs://"):]))
+
+        def spill(self, object_id, data):
+            super().spill(object_id, data)
+            # cloud-shaped URL (the deterministic <base>/<hex> layout)
+            return f"{self._uri}/{object_id.hex()}"
+
+        def restore(self, object_id, url):
+            import os as _os
+
+            return super().restore(
+                object_id,
+                _os.path.join(self.directory, url.rsplit("/", 1)[-1]))
+
+        def delete(self, url):
+            import os as _os
+
+            super().delete(
+                _os.path.join(self.directory, url.rsplit("/", 1)[-1]))
+
+    def factory(uri):
+        s = _FakeCloud(uri)
+        s._uri = uri.rstrip("/")
+        return s
+
+    register_storage_scheme("mockgs", factory)
+
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([0.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_iterations=2),
+        name="sync_exp",
+        upload_dir="mockgs://bucket/ckpts",
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+
+    # recovery path: a new Syncer (fresh process analog) finds and
+    # restores every trial's checkpoint without any local manifest
+    syncer = tune.Syncer("mockgs://bucket/ckpts", "sync_exp")
+    for r in grid:
+        meta = syncer.meta(r.trial_id)
+        assert meta is not None and meta["iteration"] == 2
+        blob = syncer.download(r.trial_id)
+        assert blob == r.checkpoint_blob and blob
+    # delete removes both the blob and the pointer
+    syncer.delete(grid[0].trial_id)
+    assert syncer.meta(grid[0].trial_id) is None
+    assert syncer.download(grid[0].trial_id) is None
+    assert syncer.trials_synced([r.trial_id for r in grid]) == \
+        [grid[1].trial_id]
+
+
 def test_tuner_trial_error_surfaces(rmt_start_regular):
     def bad_fn(config):
         if config["boom"]:
