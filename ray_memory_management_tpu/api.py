@@ -331,7 +331,15 @@ def put(value: Any, *, device: bool = False) -> ObjectRef:
         oid = _backend().put_device_object(value)
     else:
         oid = _backend().put_object(value)
-    return ObjectRef(oid, _owner())
+    # in a worker the proxy IS the reference counter for its own puts
+    # (creator-owns, reference_count.h:39); on the driver _owner() is the
+    # runtime as before
+    owner = _owner()
+    if owner is None and not device:
+        b = _backend()
+        if hasattr(b, "add_local_ref"):
+            owner = b
+    return ObjectRef(oid, owner)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],

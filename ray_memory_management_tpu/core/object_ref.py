@@ -3,14 +3,41 @@
 The Python-visible half of the reference's ObjectRef (_raylet.pyx ObjectRef):
 value-identity on the 16-byte id, picklable (so refs can be task args —
 borrowing), and hooked into the owner's reference counter on destruction
-(reference_count.h AddLocalReference/RemoveLocalReference analog). Only
-driver-created refs participate in distributed GC in round 1; worker-held
-refs pin via the in-flight-task arg pin instead.
+(reference_count.h AddLocalReference/RemoveLocalReference analog).
+Driver-created refs participate in the driver's distributed GC; refs
+deserialized INSIDE a worker register with the worker's own reference
+counter (set_deserialize_owner, installed by worker_main), which reports
+still-held borrows to the head at task completion and releases them when
+dropped — the borrowed-ref protocol of reference_count.h:39-61.
 """
 
 from __future__ import annotations
 
 from typing import Optional
+
+# Per-process hooks. _DESERIALIZE_OWNER: the reference counter
+# deserialized refs attach to — None on the driver (bare refs, owner-side
+# pinning); worker_main installs the worker's proxy so borrows are
+# tracked where they live. _SERIALIZE_OBSERVER: called with the id every
+# time a ref is pickled — the worker marks its owned puts "escaped"
+# (shipped in a return/arg/put), which blocks the free-on-owner-release
+# optimization for ids some other process may now hold.
+_DESERIALIZE_OWNER = None
+_SERIALIZE_OBSERVER = None
+
+
+def set_deserialize_owner(owner) -> None:
+    global _DESERIALIZE_OWNER
+    _DESERIALIZE_OWNER = owner
+
+
+def set_serialize_observer(observer) -> None:
+    global _SERIALIZE_OBSERVER
+    _SERIALIZE_OBSERVER = observer
+
+
+def _from_wire(object_id: bytes) -> "ObjectRef":
+    return ObjectRef(object_id, _DESERIALIZE_OWNER)
 
 
 class ObjectRef:
@@ -42,10 +69,16 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()[:16]})"
 
     def __reduce__(self):
-        # Refs serialize as bare ids; the receiving side does not register a
-        # local ref (borrowers are pinned by the owner for the duration of the
-        # borrowing task instead — simplified borrowing protocol).
-        return (ObjectRef, (self._id,))
+        # Refs serialize as bare ids. On the DRIVER the receiving side
+        # does not register a local ref (borrowers are pinned by the
+        # owner for the duration of the borrowing task). In a WORKER the
+        # deserialize hook attaches the worker's reference counter, so a
+        # ref kept alive past the task shows up in the done reply's
+        # borrowed-ref table and stays pinned until the worker drops it
+        # (reference_count.h:39-61 borrowing protocol).
+        if _SERIALIZE_OBSERVER is not None:
+            _SERIALIZE_OBSERVER(self._id)
+        return (_from_wire, (self._id,))
 
     def __del__(self):
         owner = self._owner

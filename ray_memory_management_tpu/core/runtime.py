@@ -331,6 +331,12 @@ class Runtime:
         self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)
         self._pending_schedule: deque = deque()
         self._deferred_frees: List[bytes] = []  # zero-ref batch buffer
+        # decentralized ownership bookkeeping (reference_count.h:39-61):
+        # per-worker borrow pins (each holds one local_refs count until
+        # the worker releases or dies) and per-worker owned-put
+        # attribution (objects whose owner is the producing worker)
+        self._worker_borrows: Dict[bytes, set] = {}
+        self._worker_owned: Dict[bytes, set] = {}
         # lineage pinning (reference_count.h lineage refcounting): how many
         # RETAINED task records list this oid as a ref arg. A producer's
         # record/lineage can only be pruned when no downstream record still
@@ -990,6 +996,16 @@ class Runtime:
             self._on_actor_created(handle, msg)
         elif mtype == "device_materialized":
             self._on_device_materialized(handle, msg)
+        elif mtype == "owned_put":
+            # one-way registration of a worker-owned put: the worker
+            # already minted the id and wrote its node store (zero
+            # blocking round trips on the put path). Handled INLINE so
+            # the location exists before the router reads this worker's
+            # NEXT message — a nested submit referencing the id must not
+            # race the registration on the request pool (the dep-ready
+            # check treats future-less unknown ids as ready, so losing
+            # that race would misread a live object as lost).
+            self._on_owned_put(handle, msg)
         elif mtype == "pong":
             pass
         else:
@@ -1568,6 +1584,13 @@ class Runtime:
 
             timeline.ingest_events(profile)
         nm = self.nodes.get(handle.node_id)
+        for m in msgs:
+            # borrowed-ref tables ride every done reply (success or not)
+            if m.get("borrows") or m.get("releases") \
+                    or m.get("owned_drops"):
+                self._apply_worker_ref_tables(
+                    handle, m.get("borrows"), m.get("releases"),
+                    m.get("owned_drops"))
         simple: List[tuple] = []
         errored: List[tuple] = []
         for m in msgs:
@@ -2028,6 +2051,7 @@ class Runtime:
         nm = self.nodes.get(handle.node_id)
         if nm:
             nm.remove_worker(handle)
+        self._release_worker_refs(handle)  # borrow pins die with the worker
         self._drop_device_location(handle)
         if handle.actor_id is not None:
             self._on_actor_worker_death(handle, inflight)
@@ -2531,6 +2555,86 @@ class Runtime:
                 self.futures[ref.binary()] = fut
             return fut
 
+    # ------------------------------------------- decentralized ownership
+    def _on_owned_put(self, handle: WorkerHandle, msg: dict) -> None:
+        """Register a worker-owned put (the worker minted the id and
+        wrote its node store itself — creator-owns,
+        reference_count.h:39). The head records the location and the
+        ownership attribution; the value is freed only by the owner's
+        release (guarded against live driver pins)."""
+        oid = msg["object_id"]
+        self.gcs.add_object_location(oid, handle.node_id)
+        with self._lock:
+            if msg.get("own", True):
+                self._worker_owned.setdefault(
+                    handle.worker_id.binary(), set()).add(oid)
+            fut = self.futures.get(oid)
+            if fut is None:
+                self.futures[oid] = fut = _SlimFuture()
+        if not fut.done():
+            fut.set_result(True)
+        self._on_dep_ready(oid)
+
+    def _apply_worker_ref_tables(self, handle: WorkerHandle,
+                                 borrows, releases, owned_drops) -> None:
+        """The borrowed-ref table riding a done reply
+        (reference_count.h:139-156): ``borrows`` are refs the worker
+        still holds past the task — each takes a head-side pin
+        attributed to the worker, outliving the task-duration arg pin;
+        ``releases`` are zero-count transitions worker-side — borrow
+        pins drop, and NEVER-ESCAPED owned puts (no other process can
+        hold the id) free outright; ``owned_drops`` are escaped owned
+        ids whose owner dropped its last ref — attribution only, the
+        value stays for whoever the id escaped to (bare driver refs are
+        invisible to refcounting by design)."""
+        wid = handle.worker_id.binary()
+        freed: List[bytes] = []
+        with self._lock:
+            wb = self._worker_borrows.setdefault(wid, set())
+            wo = self._worker_owned.get(wid, set())
+            # releases BEFORE borrows: one reply can carry both a
+            # release and a re-borrow of the same oid (dropped then
+            # re-acquired between two completions) — borrow-first would
+            # skip the increment ("already borrowed") and the release
+            # would then drop the pin while the worker still holds it
+            for oid in releases or ():
+                if oid in wb:
+                    wb.discard(oid)
+                    self.local_refs[oid] -= 1
+                    if self.local_refs[oid] <= 0:
+                        del self.local_refs[oid]
+                        self._deferred_frees.append(oid)
+                elif oid in wo:
+                    wo.discard(oid)
+                    if oid not in self.local_refs:
+                        # never escaped + owner dropped it + no other
+                        # pin: the owned value can go
+                        freed.append(oid)
+            for oid in owned_drops or ():
+                wo.discard(oid)
+            for oid in borrows or ():
+                if oid not in wb:
+                    wb.add(oid)
+                    self.local_refs[oid] += 1
+        if freed:
+            self.free_objects(freed)
+
+    def _release_worker_refs(self, handle: WorkerHandle) -> None:
+        """Worker died: its borrow pins release (the borrower is gone);
+        its owned puts keep their values (a driver may hold bare refs —
+        owner-death object loss stays out of scope) but lose
+        attribution."""
+        wid = handle.worker_id.binary()
+        with self._lock:
+            borrows = self._worker_borrows.pop(wid, None)
+            self._worker_owned.pop(wid, None)
+            if borrows:
+                for oid in borrows:
+                    self.local_refs[oid] -= 1
+                    if self.local_refs[oid] <= 0:
+                        del self.local_refs[oid]
+                        self._deferred_frees.append(oid)
+
     # ----------------------------------------------------- reference counting
     def add_local_ref(self, oid: bytes) -> None:
         with self._lock:
@@ -2690,24 +2794,16 @@ class Runtime:
                     fut = _SlimFuture()
                     fut.set_result(True)
                     self.futures[oid] = fut
-                reply["object_id"] = oid
-            elif mtype == "reserve_put":
-                oid = ObjectID.for_put().binary()
+                    if msg.get("own"):
+                        # the worker owns this put like a store put: the
+                        # owner-release protocol frees/drops it uniformly
+                        self._worker_owned.setdefault(
+                            handle.worker_id.binary(), set()).add(oid)
                 reply["object_id"] = oid
             elif mtype == "device_put":
                 reply["object_id"] = self.reserve_device_put(handle)
             elif mtype == "device_put_sealed":
                 self.seal_device_put(msg["object_id"])
-            elif mtype == "put_sealed":
-                oid = msg["object_id"]
-                self.gcs.add_object_location(oid, handle.node_id)
-                with self._lock:
-                    fut = self.futures.get(oid)
-                    if fut is None:
-                        self.futures[oid] = fut = _SlimFuture()
-                if not fut.done():
-                    fut.set_result(True)
-                self._on_dep_ready(oid)
             elif mtype == "wait":
                 ready, not_ready = self.wait(
                     msg["oids"], msg["num_returns"], msg["timeout"]

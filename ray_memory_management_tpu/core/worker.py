@@ -200,10 +200,88 @@ class WorkerRuntimeProxy:
         self._events: Dict[int, threading.Event] = {}
         self._req_counter = 0
         self._lock = threading.Lock()
+        # worker-side reference counting (the decentralization seed of
+        # the reference's per-worker ReferenceCounter,
+        # reference_count.h:39-61): this worker counts its OWN refs —
+        # objects it put (it is the owner) and refs it deserialized
+        # (borrows). Borrows still alive at task completion ship to the
+        # head in the done reply's borrowed-ref table (the head converts
+        # the task-duration arg pin into a worker-attributed pin);
+        # zero-count transitions buffer into ``releases`` riding the
+        # next done reply — no dedicated round trips in either
+        # direction.
+        # RLock: __del__ can fire inside any of these methods (a gc pass
+        # collecting a ref cycle) and re-enter remove_local_ref
+        self._ref_lock = threading.RLock()
+        self._ref_counts: Dict[bytes, int] = {}
+        self._owned: set = set()      # oids this worker put (owner)
+        self._escaped: set = set()    # owned ids pickled OUT of this worker
+        self._reported: set = set()   # borrows pinned head-side
+        self._release_buf: List[bytes] = []
+        self._owned_drop_buf: List[bytes] = []
+        self.head_round_trips = 0  # observability: blocking owner RTs
 
     @property
     def inline_limit(self) -> int:
         return self._worker.inline_limit
+
+    # -- worker-side reference counting ---------------------------------------
+    def add_local_ref(self, oid: bytes) -> None:
+        with self._ref_lock:
+            self._ref_counts[oid] = self._ref_counts.get(oid, 0) + 1
+
+    def mark_escaped(self, oid: bytes) -> None:
+        """Called from ObjectRef.__reduce__ (serialize observer): the id
+        left this process in a return/arg/put, so another process may
+        hold it — the owner's release may only drop attribution, never
+        free the value."""
+        with self._ref_lock:
+            if oid in self._owned:
+                self._escaped.add(oid)
+
+    def remove_local_ref(self, oid: bytes) -> None:
+        with self._ref_lock:
+            n = self._ref_counts.get(oid, 0) - 1
+            if n > 0:
+                self._ref_counts[oid] = n
+                return
+            self._ref_counts.pop(oid, None)
+            owned = oid in self._owned
+            reported = oid in self._reported
+            self._owned.discard(oid)
+            self._reported.discard(oid)
+            if owned and oid in self._escaped:
+                # the id is out in the world: the head only drops the
+                # ownership attribution
+                self._escaped.discard(oid)
+                self._owned_drop_buf.append(oid)
+            elif owned or reported:
+                # the head holds freeable/pinned state: queue the release
+                # (riding the next done reply — see ref_tables)
+                self._release_buf.append(oid)
+
+    def ref_tables(self) -> dict:
+        """Borrow/release tables to piggyback on a done reply: new
+        borrows (live deserialized refs not yet pinned head-side),
+        buffered zero-count releases, and escaped-owned attribution
+        drops. Called at completion-build time AFTER the frame's locals
+        are dropped — the tables ride the reply, costing zero extra pipe
+        writes."""
+        out: dict = {}
+        with self._ref_lock:
+            borrows = [oid for oid, n in self._ref_counts.items()
+                       if n > 0 and oid not in self._owned
+                       and oid not in self._reported]
+            if borrows:
+                self._reported.update(borrows)
+                out["borrows"] = borrows
+            if self._release_buf:
+                out["releases"] = self._release_buf
+                self._release_buf = []
+            if self._owned_drop_buf:
+                out["owned_drops"] = self._owned_drop_buf
+                self._owned_drop_buf = []
+        return out
 
     def _request(self, msg: dict, timeout: Optional[float] = None):
         with self._lock:
@@ -212,6 +290,7 @@ class WorkerRuntimeProxy:
             ev = threading.Event()
             self._events[req_id] = ev
         msg["req_id"] = req_id
+        self.head_round_trips += 1
         self._worker.sender.send(msg)
         # an owner round trip can block on dependencies this worker itself
         # has queued — let the pipeline keep draining while we park
@@ -300,20 +379,67 @@ class WorkerRuntimeProxy:
                 time.sleep(0.05 * attempt)
         return [out[oid] for oid in oids]
 
-    def put_object(self, value: Any) -> bytes:
-        data = ser.serialize(value)
+    def _direct_store_put(self, data, own: bool) -> bytes:
+        """Shared body of the decentralized put paths: mint the id in
+        THIS worker, write straight into the node's shm store (asking
+        the head to make room once on pressure), and register via a
+        ONE-WAY ``owned_put`` frame — zero blocking round trips
+        (previously two: reserve_put + put_sealed). Pipe FIFO + the
+        head's inline handling guarantee the registration lands before
+        any later message referencing the id. Small values and
+        full-store degradation go through ``put_inline`` (owner memory);
+        with ``own`` those also register in the owned table so the
+        owner-release protocol applies uniformly."""
+        from ..ids import ObjectID
+        from ..native import ShmStoreFullError
+
         if data.total_size <= self._worker.inline_limit:
             reply = self._request(
-                {"type": "put_inline", "data": data.to_bytes()}
-            )
-            return reply["object_id"]
-        reply = self._request(
-            {"type": "reserve_put", "size": data.total_size}
-        )
-        oid = reply["object_id"]
-        self._worker.store.put_serialized(oid, data)
-        self._request({"type": "put_sealed", "object_id": oid})
+                {"type": "put_inline", "data": data.to_bytes(),
+                 "own": own})
+            oid = reply["object_id"]
+            if own:
+                with self._ref_lock:
+                    self._owned.add(oid)
+            return oid
+        oid = ObjectID.for_put().binary()
+        stored = False
+        for attempt in range(2):
+            try:
+                self._worker.store.put_serialized(oid, data)
+                stored = True
+                break
+            except ShmStoreFullError:
+                if attempt == 0:
+                    try:
+                        self._request({"type": "make_room",
+                                       "bytes": data.total_size},
+                                      timeout=60)
+                    except Exception:  # noqa: BLE001 — fall through
+                        break
+        if not stored:
+            # node store full past spilling: owner-memory inline put is
+            # the last resort (same degradation as oversized returns)
+            reply = self._request(
+                {"type": "put_inline", "data": data.to_bytes(),
+                 "own": own})
+            oid = reply["object_id"]
+            if own:
+                with self._ref_lock:
+                    self._owned.add(oid)
+            return oid
+        if own:
+            with self._ref_lock:
+                self._owned.add(oid)
+        self._worker.sender.send({"type": "owned_put", "object_id": oid,
+                                  "own": own})
         return oid
+
+    def put_object(self, value: Any) -> bytes:
+        """Store a value with THIS WORKER as the owner — the
+        ownership-decentralization seed (reference_count.h:39 'the
+        worker that creates the ObjectRef owns it')."""
+        return self._direct_store_put(ser.serialize(value), own=True)
 
     def put_device_object(self, value: Any) -> bytes:
         """Pin a jax.Array in this worker's device store; two-phase with
@@ -332,15 +458,11 @@ class WorkerRuntimeProxy:
         return oid
 
     def put_serialized_arg(self, data) -> bytes:
-        if data.total_size <= self._worker.inline_limit:
-            reply = self._request({"type": "put_inline",
-                                   "data": data.to_bytes()})
-            return reply["object_id"]
-        reply = self._request({"type": "reserve_put", "size": data.total_size})
-        oid = reply["object_id"]
-        self._worker.store.put_serialized(oid, data)
-        self._request({"type": "put_sealed", "object_id": oid})
-        return oid
+        """Big nested-task args: same zero-round-trip direct store write
+        as put_object, but with ``own: False`` — no ObjectRef ever wraps
+        these ids (the task spec holds them), so the head keeps plain
+        location state without owner attribution."""
+        return self._direct_store_put(data, own=False)
 
     def wait(self, oids: List[bytes], num_returns: int, timeout, fetch_local):
         reply = self._request({
@@ -540,6 +662,7 @@ class Worker:
     def exec_task(self, msg: dict) -> None:
         task_id = msg["task_id"]
         pinned: List[bytes] = []
+        args = kwargs = result = returns = None
         t0 = time.time()
         try:
             self._apply_chip_lease(msg)
@@ -567,8 +690,17 @@ class Worker:
         finally:
             for oid in pinned:
                 self.store.release(oid)
+        # drop the frame's refs BEFORE computing the borrow table: only
+        # refs the USER retained (actor/global state) count as borrows —
+        # args/result dying with the call must not ping-pong pin/release
+        # through the head every task
+        args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"task::{msg.get('name', 'task')}", t0)
+        # borrowed-ref table + buffered releases ride the done reply
+        # (reference_count.h:139-156: the borrowed-ref table ships back
+        # on task completion) — zero extra pipe writes
+        reply.update(self.proxy.ref_tables())
         self.sender.send(reply)
 
     def _profile_batch(self, span_name: str, t0: float) -> List[dict]:
@@ -709,8 +841,12 @@ class Worker:
                      "error": self._encode_error(msg["method"], e)}
         for oid in pinned:
             self.store.release(oid)
+        # only refs retained in actor/user state survive this drop and
+        # count as borrows (see exec_task)
+        args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0)
+        reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
 
     def _finish_actor_task(self, msg: dict, t0: float, pinned: List[bytes],
@@ -732,8 +868,19 @@ class Worker:
         finally:
             for oid in pinned:
                 self.store.release(oid)
+        # drop before the borrow table — including the Future's stored
+        # result, which would otherwise keep returned refs alive and
+        # falsely report them as borrows (released only at the NEXT
+        # done, or never on an idle actor)
+        result = returns = None  # noqa: F841
+        try:
+            fut._result = None
+        except AttributeError:
+            pass
+        fut = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0)
+        reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
 
     # -- log streaming --------------------------------------------------------

@@ -46,6 +46,14 @@ def main() -> None:
     from .worker import Worker
 
     w = Worker(conn, worker_id, node_id, store_name, inline_limit)
+    # refs deserialized in this process register with THIS worker's
+    # reference counter (borrowed-ref protocol, reference_count.h:39-61);
+    # refs serialized OUT mark their ids escaped (blocks the
+    # free-on-owner-release fast path for ids other processes may hold)
+    from .object_ref import set_deserialize_owner, set_serialize_observer
+
+    set_deserialize_owner(w.proxy)
+    set_serialize_observer(w.proxy.mark_escaped)
     if _bootstrap is not None:
         w.bootstrap_msg = _bootstrap
     if os.environ.get("RMT_WORKER_PROFILE"):
