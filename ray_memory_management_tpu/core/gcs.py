@@ -253,6 +253,21 @@ class GCS:
         with self._lock:
             return set(self.object_locations.get(oid, ()))
 
+    def take_objects_locations(self, oids) -> Dict[bytes, Set[NodeID]]:
+        """Batch pop: every listed object's location set, removed from
+        the directory, ONE lock acquisition. The free path over a
+        completion burst calls this once instead of 2N per-oid calls
+        (per-oid get+remove was a measurable slice of the router's free
+        work at high task rates); oids with no locations — inline
+        returns — are simply absent from the result."""
+        out: Dict[bytes, Set[NodeID]] = {}
+        with self._lock:
+            for oid in oids:
+                locs = self.object_locations.pop(oid, None)
+                if locs:
+                    out[oid] = locs
+        return out
+
     def drop_node_objects(self, node_id: NodeID) -> List[bytes]:
         """Remove a dead node from the directory; returns objects that now
         have zero locations (candidates for lineage reconstruction)."""

@@ -324,6 +324,13 @@ class Runtime:
         self.tasks: Dict[bytes, _TaskRecord] = {}
         self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
         self.local_refs: Dict[bytes, int] = defaultdict(int)
+        # dedicated refcount shard: ObjectRef __del__/__init__ storms on
+        # the APPLICATION thread must not contend with the router's
+        # dispatch/completion work under the big runtime lock (the
+        # task-hot-path profile showed exactly that contention). Guards
+        # local_refs + _deferred_frees only. Lock order: _ref_mu nests
+        # INSIDE _lock; never take _lock while holding _ref_mu.
+        self._ref_mu = threading.Lock()
         self.actors: Dict[bytes, _ActorInfo] = {}
         self.fn_blobs: Dict[bytes, bytes] = {}
         self.cls_blobs: Dict[bytes, bytes] = {}
@@ -1006,6 +1013,11 @@ class Runtime:
             # check treats future-less unknown ids as ready, so losing
             # that race would misread a live object as lost).
             self._on_owned_put(handle, msg)
+        elif mtype == "profile":
+            # straggler span batch from an idling worker's flush ticker
+            from ..utils import timeline
+
+            timeline.ingest_events(msg["profile"])
         elif mtype == "pong":
             pass
         else:
@@ -1068,19 +1080,22 @@ class Runtime:
                           gc_returns=adopt_returns)
         with self._lock:
             self.tasks[spec.task_id] = rec
-            for oid in return_ids:
-                self.futures[oid] = _SlimFuture()
-                self.lineage[oid] = spec.task_id
-                if adopt_returns:
-                    # pre-registered handle ref, ADOPTED by the caller's
-                    # ObjectRef: without it a fast task completing before
-                    # the wrap would see refcount zero and GC its result
+            with self._ref_mu:
+                for oid in return_ids:
+                    self.futures[oid] = _SlimFuture()
+                    self.lineage[oid] = spec.task_id
+                    if adopt_returns:
+                        # pre-registered handle ref, ADOPTED by the
+                        # caller's ObjectRef: without it a fast task
+                        # completing before the wrap would see refcount
+                        # zero and GC its result
+                        self.local_refs[oid] += 1
+                # the pending task keeps its ref args (and their
+                # lineage) alive even if the caller drops every handle
+                # before it runs
+                for oid in self._ref_deps(spec):
                     self.local_refs[oid] += 1
-            # the pending task keeps its ref args (and their lineage)
-            # alive even if the caller drops every handle before it runs
-            for oid in self._ref_deps(spec):
-                self.local_refs[oid] += 1
-                self._lineage_dependents[oid] += 1
+                    self._lineage_dependents[oid] += 1
             nudge = self._queue_when_deps_ready_locked(spec)
         if nudge:
             self._wakeup()
@@ -1483,7 +1498,12 @@ class Runtime:
     def _pump(self) -> None:
         if self.pg_manager is not None:
             self.pg_manager.retry_pending()
-        self._flush_deferred_frees()
+        # free-flushing is ROUTER-only work: an application thread that
+        # inline-pumps on submit must not pay for store deletes + record
+        # prune cascades there (that cost on the submitting thread is
+        # what the deferred buffer exists to avoid)
+        if threading.current_thread() is self._router:
+            self._flush_deferred_frees()
         with self._lock:
             submits = list(self._submit_q)
             self._submit_q.clear()
@@ -1649,19 +1669,21 @@ class Runtime:
                 if spec is not None and rec is not None \
                         and not rec.args_released:
                     rec.args_released = True
-                    for oid in self._ref_deps(spec):
-                        self.local_refs[oid] -= 1
-                        if self.local_refs[oid] <= 0:
-                            del self.local_refs[oid]
-                            to_free.append(oid)
+                    with self._ref_mu:
+                        for oid in self._ref_deps(spec):
+                            self.local_refs[oid] -= 1
+                            if self.local_refs[oid] <= 0:
+                                del self.local_refs[oid]
+                                to_free.append(oid)
                 if spec is not None and rec is not None and rec.gc_returns:
                     # returns whose every handle was dropped BEFORE the
                     # task finished have no refcount-zero transition left
                     # to trigger GC — sweep them now (driver-owned refs
                     # only: worker/client return handles are bare)
-                    to_free.extend(
-                        roid for roid in spec.return_ids
-                        if roid not in self.local_refs)
+                    with self._ref_mu:
+                        to_free.extend(
+                            roid for roid in spec.return_ids
+                            if roid not in self.local_refs)
         _SlimFuture.broadcast()  # wake getters once for the whole burst
         self.free_objects(to_free)
         if nudge:
@@ -1853,16 +1875,17 @@ class Runtime:
                           gc_returns=adopt_returns)
         with self._lock:
             self.tasks[spec.task_id] = rec
-            for oid in return_ids:
-                self.futures[oid] = _SlimFuture()
-                # lineage here serves record GC, not reconstruction —
-                # _recover_object refuses actor results explicitly
-                self.lineage[oid] = spec.task_id
-                if adopt_returns:
+            with self._ref_mu:
+                for oid in return_ids:
+                    self.futures[oid] = _SlimFuture()
+                    # lineage here serves record GC, not reconstruction —
+                    # _recover_object refuses actor results explicitly
+                    self.lineage[oid] = spec.task_id
+                    if adopt_returns:
+                        self.local_refs[oid] += 1
+                for oid in self._ref_deps(spec):
                     self.local_refs[oid] += 1
-            for oid in self._ref_deps(spec):
-                self.local_refs[oid] += 1
-                self._lineage_dependents[oid] += 1
+                    self._lineage_dependents[oid] += 1
         state = info.record.state
         if state == ACTOR_DEAD:
             self._fail_task(spec, ActorDiedError(
@@ -2460,8 +2483,9 @@ class Runtime:
             # must see the args — and its own result — as referenced
             if rec.args_released:
                 rec.args_released = False
-                for aoid in self._ref_deps(spec):
-                    self.local_refs[aoid] += 1
+                with self._ref_mu:
+                    for aoid in self._ref_deps(spec):
+                        self.local_refs[aoid] += 1
         self._resolve_deps_then_schedule(spec)
         for roid in spec.return_ids:
             with self._lock:
@@ -2589,7 +2613,7 @@ class Runtime:
         invisible to refcounting by design)."""
         wid = handle.worker_id.binary()
         freed: List[bytes] = []
-        with self._lock:
+        with self._lock, self._ref_mu:
             wb = self._worker_borrows.setdefault(wid, set())
             wo = self._worker_owned.get(wid, set())
             # releases BEFORE borrows: one reply can carry both a
@@ -2625,7 +2649,7 @@ class Runtime:
         owner-death object loss stays out of scope) but lose
         attribution."""
         wid = handle.worker_id.binary()
-        with self._lock:
+        with self._lock, self._ref_mu:
             borrows = self._worker_borrows.pop(wid, None)
             self._worker_owned.pop(wid, None)
             if borrows:
@@ -2637,30 +2661,31 @@ class Runtime:
 
     # ----------------------------------------------------- reference counting
     def add_local_ref(self, oid: bytes) -> None:
-        with self._lock:
+        with self._ref_mu:
             self.local_refs[oid] += 1
 
     def remove_local_ref(self, oid: bytes) -> None:
-        # zero-ref frees batch through a small deferred buffer: a driver
-        # dropping a list of refs (every `del refs` after a bulk get)
-        # fires thousands of __del__s back-to-back, and one free_objects
-        # pass over 128 ids costs a fraction of 128 single-id passes.
-        # The pump loop flushes stragglers so an idle driver still
-        # releases store memory promptly.
-        with self._lock:
+        # zero-ref frees batch through a deferred buffer the ROUTER pump
+        # drains: a driver dropping a list of refs (every `del refs`
+        # after a bulk get) fires thousands of __del__s back-to-back on
+        # the application thread, and the free pass (store deletes +
+        # task-record prune cascades) was ~60% of that thread's time in
+        # the task hot path. Here we only decrement and buffer; crossing
+        # the batch threshold nudges the router, which frees between
+        # dispatch rounds (_flush_deferred_frees in _pump).
+        with self._ref_mu:
             self.local_refs[oid] -= 1
             if self.local_refs[oid] > 0:
                 return
             del self.local_refs[oid]
             self._deferred_frees.append(oid)
-            if len(self._deferred_frees) < 128:
-                return
-            batch = self._take_deferred_frees_locked()
-        self.free_objects(batch)
+            nudge = len(self._deferred_frees) == 128
+        if nudge:
+            self._wakeup()
 
     def _take_deferred_frees_locked(self) -> List[bytes]:
-        """With self._lock held: drain the deferral buffer, SKIPPING any
-        oid that picked up a live reference since its count hit zero
+        """With self._ref_mu held: drain the deferral buffer, SKIPPING
+        any oid that picked up a live reference since its count hit zero
         (e.g. a cached ref handed out again, a borrowed bare-id re-pinned
         at submission) — freeing those would drop a value a live handle
         still expects. The synchronous pre-batching free could never see
@@ -2671,7 +2696,7 @@ class Runtime:
         return batch
 
     def _flush_deferred_frees(self) -> None:
-        with self._lock:
+        with self._ref_mu:
             if not self._deferred_frees:
                 return
             batch = self._take_deferred_frees_locked()
@@ -2696,34 +2721,40 @@ class Runtime:
                     or not rec.args_released):
                 continue
             rets = rec.spec.return_ids
-            if any(r in self.local_refs for r in rets):
-                continue  # a handle (or a pending task's arg pin) lives
-            if any(self._lineage_dependents.get(r, 0) > 0 for r in rets):
-                continue  # a retained downstream record may reconstruct
-            if any(r in self.futures and not self.futures[r].done()
-                   for r in rets):
-                continue  # an unresolved future may have waiters
-            for r in rets:
-                self.futures.pop(r, None)
-                self.lineage.pop(r, None)
-                self.memory_store.pop(r, None)
-            # raw tuple: this runs once per completed task, and building a
-            # keyed dict (plus .hex()) here showed in the completion hot
-            # path — the state API renders rows lazily on read
-            self.task_history.append(
-                (tid, rec.spec.name, rec.state, rec.spec.num_returns,
-                 rec.retries_left, rec.spec.is_actor_task))
-            del self.tasks[tid]
-            for a in self._ref_deps(rec.spec):
-                n = self._lineage_dependents.get(a, 0) - 1
-                if n > 0:
-                    self._lineage_dependents[a] = n
-                else:
-                    self._lineage_dependents.pop(a, None)
-                    # the arg's producer may have been waiting on us
-                    ptid = self.lineage.get(a)
-                    if ptid is not None and a not in self.local_refs:
-                        stack.append(ptid)
+            # _ref_mu spans the handle check AND the pops: an app-thread
+            # add_local_ref (a cached ref handed out again) must not
+            # land between "no handle lives" and the future/value drop
+            with self._ref_mu:
+                if any(r in self.local_refs for r in rets):
+                    continue  # a handle (or a task's arg pin) lives
+                if any(self._lineage_dependents.get(r, 0) > 0
+                       for r in rets):
+                    continue  # a retained downstream record remains
+                if any(r in self.futures and not self.futures[r].done()
+                       for r in rets):
+                    continue  # an unresolved future may have waiters
+                for r in rets:
+                    self.futures.pop(r, None)
+                    self.lineage.pop(r, None)
+                    self.memory_store.pop(r, None)
+                # raw tuple: this runs once per completed task, and
+                # building a keyed dict (plus .hex()) here showed in the
+                # completion hot path — the state API renders rows
+                # lazily on read
+                self.task_history.append(
+                    (tid, rec.spec.name, rec.state, rec.spec.num_returns,
+                     rec.retries_left, rec.spec.is_actor_task))
+                del self.tasks[tid]
+                for a in self._ref_deps(rec.spec):
+                    n = self._lineage_dependents.get(a, 0) - 1
+                    if n > 0:
+                        self._lineage_dependents[a] = n
+                    else:
+                        self._lineage_dependents.pop(a, None)
+                        # the arg's producer may have been waiting on us
+                        ptid = self.lineage.get(a)
+                        if ptid is not None and a not in self.local_refs:
+                            stack.append(ptid)
 
     def free_object(self, oid: bytes) -> None:
         self.free_objects((oid,))
@@ -2758,12 +2789,13 @@ class Runtime:
             self.device_store.delete(oid)
         for loc, oid in device_remote:
             self._send(loc, {"type": "free_device", "object_id": oid})
-        for oid in oids:
-            for node_id in self.gcs.get_object_locations(oid):
+        # one batched directory pop for the whole burst; inline-return
+        # oids (no store copy anywhere) cost nothing here
+        for oid, locs in self.gcs.take_objects_locations(oids).items():
+            for node_id in locs:
                 nm = self.nodes.get(node_id)
                 if nm and nm.alive:
                     nm.store.delete(oid)
-                self.gcs.remove_object_location(oid, node_id)
 
     # ------------------------------------------------------ worker requests
     def _serve_worker_request(self, handle: WorkerHandle, msg: dict) -> None:

@@ -713,7 +713,9 @@ class Worker:
             span_name, "task", t0, time.time(),
             pid=f"worker:{self.worker_id.hex()[:8]}",
         )
-        return timeline.drain_events()
+        # amortized: most replies carry no profile; every ~64th (or 1s)
+        # carries the batch — stragglers ship via _profile_flush_loop
+        return timeline.drain_events_if_due()
 
     @staticmethod
     def _split_returns(result, return_ids):
@@ -916,12 +918,28 @@ class Worker:
                          name="log-capture").start()
 
     # -- main loop ------------------------------------------------------------
+    def _profile_flush_loop(self) -> None:
+        """Straggler profile spans: the done-reply path batches spans
+        (drain_events_if_due), so an idle worker could sit on a tail of
+        undelivered spans forever — this 1 s ticker ships them as a
+        standalone frame. No-op (no send, no wakeups) while empty."""
+        from ..utils import timeline
+
+        while not self._shutdown.is_set():
+            self._shutdown.wait(1.0)
+            evs = timeline.drain_events_if_due(min_batch=1,
+                                               max_age_s=1.0)
+            if evs:
+                self.sender.send({"type": "profile", "profile": evs})
+
     def run(self) -> None:
         from .. import _worker_context
 
         _worker_context.set_proxy(self.proxy)
         if os.environ.get("RMT_LOG_TO_DRIVER") == "1":
             self.start_output_capture()
+        threading.Thread(target=self._profile_flush_loop, daemon=True,
+                         name="profile-flush").start()
         # registration doubles as the ready signal (exec-then-connect
         # handshake; the runtime binds this connection to our WorkerHandle)
         self.sender.send({"type": "ready", "worker_id": self.worker_id,

@@ -34,13 +34,17 @@ BASELINE = {
 
 
 def _timeit(fn: Callable[[int], None], n: int, warmup: int = 1,
-            trials: int = 3) -> "_Row":
+            trials: int = 3, warmup_n: int = 0) -> "_Row":
     """Run ``fn(n)`` ``trials`` times after a warmup; report the MEDIAN
     rate with min/max dispersion. Single-trial numbers made every perf
     regression unfalsifiable — a swing could always be noise; the median
-    of three with recorded spread is cheap and decidable."""
+    of three with recorded spread is cheap and decidable. ``warmup_n``
+    overrides the warmup size (default n//10): burst-shaped rows need a
+    FULL-SCALE untimed pass to reach steady state (worker pool at final
+    size, pipelining depth built up) — the same discipline as the scale
+    bench's untimed actor burst."""
     for _ in range(warmup):
-        fn(max(1, n // 10))
+        fn(max(1, warmup_n or n // 10))
     rates = []
     for _ in range(max(1, trials)):
         t0 = time.perf_counter()
@@ -116,7 +120,12 @@ def run_microbenchmark(scale: float = 1.0,
         def tasks_async(n):
             rmt.get([small_task.remote() for _ in range(n)], timeout=300)
 
-        results["single_client_tasks_async"] = _timeit(tasks_async, int(3000 * scale))
+        # 5 trials: this row's inter-trial spread on the 1-core host is
+        # the widest in the suite (±20%); the median of five is the same
+        # honest statistic with half the run-to-run bounce
+        results["single_client_tasks_async"] = _timeit(
+            tasks_async, int(3000 * scale), warmup_n=int(3000 * scale),
+            trials=5)
 
     if want("1_1_actor_calls_sync") or want("1_1_actor_calls_async"):
         actor = Sink.remote()
