@@ -21,6 +21,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Set
 
@@ -36,7 +37,8 @@ class WorkerHandle:
                  "known_fns", "known_classes", "actor_id", "inflight",
                  "lease_resources", "visible_chips", "pending_msgs",
                  "death_processed", "send_lock", "steal_pending",
-                 "re_inflight", "conda_key", "_alive_checked_at")
+                 "re_inflight", "conda_key", "spawned_at",
+                 "_alive_checked_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -63,6 +65,7 @@ class WorkerHandle:
         self.lease_resources: Optional[Resources] = None
         self.visible_chips: Optional[List[int]] = None
         self.pending_msgs: List[dict] = []  # queued until registration
+        self.spawned_at = 0.0  # set at spawn; boot latency at ready
         self._alive_checked_at = 0.0
 
     def alive(self) -> bool:
@@ -202,6 +205,17 @@ class NodeManager:
         self.config = config
         self.store = NodeObjectStore(store_name, config, create=True)
         self.store_name = store_name
+        self._on_worker_started = on_worker_started
+        total_chips = int(resources.total.get(TPU))
+        self.free_chips: List[int] = list(range(total_chips))
+        self._init_pool_state()
+
+    def _init_pool_state(self) -> None:
+        """Worker-pool bookkeeping shared with RemoteNodeManager, which
+        bypasses ``__init__`` (it has no local store to create). Every
+        pool field MUST live here, not in ``__init__``: a field added
+        there surfaces as an AttributeError the first time an inherited
+        pool method runs against a remote node."""
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.idle_workers: deque = deque()
         # pool workers currently holding a lease; pipelining candidates
@@ -211,15 +225,15 @@ class NodeManager:
         self.queue: deque = deque()  # TaskSpec leased to this node
         self.starting = 0
         self.alive = True
-        self._on_worker_started = on_worker_started
         self._lock = threading.RLock()
-        total_chips = int(resources.total.get(TPU))
-        self.free_chips: List[int] = list(range(total_chips))
         # dedicated conda-env workers, one warm pool per env key: their
         # process is the env's python, so they never mix with the main
         # pool (worker_pool.h:446 dedicated runtime-env workers)
         self.conda_idle: Dict[str, deque] = {}
         self._conda_starting: Set[str] = set()
+        # phase accounting (scale bench): spawn-return -> worker-ready
+        self.boot_seconds = 0.0
+        self.boot_count = 0
 
     # -- worker pool ----------------------------------------------------------
     def start_conda_worker(self, conda_spec, conda_key: str) -> None:
@@ -325,6 +339,9 @@ class NodeManager:
             # exists, so the flush cannot have happened yet.
             handle.pending_msgs.append(bootstrap)
 
+        # BEFORE the spawn: a bootstrapped fork can register before this
+        # returns, and on_worker_ready skips the boot sample at 0
+        handle.spawned_at = time.monotonic()
         handle.proc = spawn_worker_process(env, self.config, bootstrap,
                                            queue_bootstrap,
                                            python_exe=python_exe)
@@ -348,6 +365,9 @@ class NodeManager:
     def on_worker_ready(self, handle: WorkerHandle) -> None:
         with self._lock:
             handle.ready = True
+            if handle.spawned_at:
+                self.boot_seconds += time.monotonic() - handle.spawned_at
+                self.boot_count += 1
             self.starting = max(0, self.starting - 1)
             if handle.conda_key is not None:
                 self._conda_starting.discard(handle.conda_key)

@@ -139,16 +139,8 @@ class RemoteNodeManager(NodeManager):
         self.config = config
         self.store = RemoteStoreProxy(self)
         self.store_name = f"remote:{hostname}"
-        self.workers: Dict[WorkerID, WorkerHandle] = {}
-        from collections import deque
-
-        self.idle_workers = deque()
-        self.busy_pool = set()
-        self.queue = deque()
-        self.starting = 0
-        self.alive = True
         self._on_worker_started = on_worker_started
-        self._lock = threading.RLock()
+        self._init_pool_state()
         from .resources import TPU
 
         total_chips = int(resources.total.get(TPU))
@@ -436,6 +428,10 @@ class RemoteNodeManager(NodeManager):
             # conda envs are HOST-local: the agent resolves/creates the
             # env on its own machine and spawns under its python
             msg["conda"] = conda_spec
+        # BEFORE the frame leaves: a bootstrapped fork on the agent can
+        # register before channel_send returns, and on_worker_ready skips
+        # the boot sample when spawned_at is still 0
+        handle.spawned_at = time.monotonic()
         self.channel_send(msg)
         return handle
 

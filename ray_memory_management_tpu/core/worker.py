@@ -38,6 +38,13 @@ from typing import Any, Dict, List, Optional
 from .. import serialization as ser
 from .object_store import StoreClient
 
+# Actor classes preloaded by the ZYGOTE before forking (zygote.serve):
+# every forked child inherits the loaded class via COW and skips its own
+# cloudpickle.loads — the dominant per-child Python cost in an actor
+# burst after the fork itself. Keyed by cls_id; plain dict (the zygote
+# populates it pre-fork; children only read).
+PRELOADED_CLASSES: Dict[bytes, Any] = {}
+
 
 class _ReplySender:
     """Reply writer owned by one persistent drain thread (the mirror of the
@@ -763,12 +770,17 @@ class Worker:
         try:
             self._apply_chip_lease(msg)
             cls_id = msg["cls_id"]
-            cls = self.classes.get(cls_id)
+            cls = self.classes.get(cls_id) or PRELOADED_CLASSES.get(cls_id)
             if cls is None:
+                blob = msg.get("cls_blob")
+                if blob is None:  # stripped blob + no preload: a bug
+                    raise RuntimeError(
+                        f"class {cls_id.hex()} neither preloaded nor "
+                        "shipped with the create")
                 import cloudpickle
 
-                cls = cloudpickle.loads(msg["cls_blob"])
-                self.classes[cls_id] = cls
+                cls = cloudpickle.loads(blob)
+            self.classes[cls_id] = cls
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             # actors own their dedicated worker process: the env applies
             # for the process lifetime (async + concurrent methods see it

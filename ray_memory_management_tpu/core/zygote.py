@@ -83,6 +83,36 @@ def serve(socket_path: str, authkey: bytes) -> None:
 
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap forked workers
     listener = Listener(socket_path, family="AF_UNIX", authkey=authkey)
+    # frozen child baseline for the env-delta protocol: clients compute
+    # deltas against the env they LAUNCHED the zygote with, so children
+    # must reset to that exact snapshot — resetting to the live
+    # os.environ instead would leak any environ drift (e.g. a preloaded
+    # class's import setting XLA_FLAGS) into every later worker
+    base_env = {k: v for k, v in os.environ.items()
+                if k != "RMT_ZYGOTE_AUTHKEY"}
+
+    def jax_backend_live() -> bool:
+        mod = sys.modules.get("jax")
+        if mod is None:
+            return False
+        try:
+            from jax._src import xla_bridge
+
+            return bool(xla_bridge._backends)
+        except Exception:  # noqa: BLE001 — structure drift: assume live
+            return True
+        # (conservative: a layout we can't inspect is treated as live)
+
+    # actor-class preload cache: the FIRST spawn carrying a given
+    # cls_blob unpickles it HERE, once — every subsequent fork inherits
+    # the loaded class via COW and skips the per-child cloudpickle.loads
+    # (measured at a meaningful slice of the 2,000-actor burst's
+    # per-child CPU). worker.create_actor checks this cache by cls_id.
+    # Loading user code pre-fork risks the no-live-jax-backend invariant
+    # (a blob whose import chain initializes a PJRT client would hand
+    # every future child a fork-broken backend), so a load that trips
+    # the guard below retires this zygote: the client cold-spawns the
+    # current worker, blacklists the class, and starts a fresh zygote.
     while True:
         try:
             conn = listener.accept()
@@ -102,6 +132,35 @@ def serve(socket_path: str, authkey: bytes) -> None:
                 except OSError:
                     pass
                 return
+            bootstrap = msg.get("bootstrap")
+            cls_cached = False
+            if bootstrap is not None and not msg.get("no_preload"):
+                cls_id = bootstrap.get("cls_id")
+                if cls_id is not None:
+                    if cls_id in worker.PRELOADED_CLASSES:
+                        cls_cached = True
+                    elif bootstrap.get("cls_blob") is not None:
+                        try:
+                            worker.PRELOADED_CLASSES[cls_id] = \
+                                cloudpickle.loads(bootstrap["cls_blob"])
+                            cls_cached = True
+                        except Exception:  # noqa: BLE001 — child loads
+                            pass           # it from the blob as before
+                        if jax_backend_live():
+                            # the load initialized a backend in THIS
+                            # process: forking now is unsafe. Retire.
+                            worker.PRELOADED_CLASSES.pop(cls_id, None)
+                            try:
+                                conn.send({"cls_taint": True})
+                            except (OSError, BrokenPipeError):
+                                pass
+                            conn.close()
+                            try:
+                                listener.close()
+                                os.unlink(socket_path)
+                            except OSError:
+                                pass
+                            return
             try:
                 pid = os.fork()
             except OSError as e:
@@ -116,16 +175,30 @@ def serve(socket_path: str, authkey: bytes) -> None:
                     conn.close()
                     listener.close()
                     signal.signal(signal.SIGCHLD, signal.SIG_DFL)
-                    os.environ.clear()
-                    os.environ.update(msg["env"])
-                    worker_main._bootstrap = msg.get("bootstrap")
+                    if "env" in msg:
+                        os.environ.clear()
+                        os.environ.update(msg["env"])
+                    else:
+                        # delta protocol: the child resets to the FROZEN
+                        # launch snapshot (the dict the client computed
+                        # its delta against) — per spawn only the
+                        # handful of per-worker vars cross the socket
+                        # instead of the full ~3KB environment
+                        os.environ.clear()
+                        os.environ.update(base_env)
+                        for k in msg.get("env_removed") or ():
+                            os.environ.pop(k, None)
+                        os.environ.update(msg.get("env_delta") or {})
+                    worker_main._bootstrap = bootstrap
                     worker_main.main()
                 except BaseException:  # noqa: BLE001 — never unwind into
                     os._exit(1)        # the zygote's stack in a fork child
                 os._exit(0)
             # --- parent --------------------------------------------------
             try:
-                conn.send({"pid": pid})
+                # cls_cached acks the preload: the client then strips the
+                # multi-KB cls_blob from subsequent spawns of this class
+                conn.send({"pid": pid, "cls_cached": cls_cached})
             except (OSError, BrokenPipeError):
                 conn.close()
                 break
@@ -220,6 +293,8 @@ class ZygoteClient:
         for var in Config().cpu_worker_env_drop.split(","):
             if var:
                 env.pop(var.strip(), None)
+        # children inherit this exact dict; spawn() ships only the delta
+        self._base_env = dict(env)
         self._proc = subprocess.Popen(
             [sys.executable, "-m",
              "ray_memory_management_tpu.core.zygote", self._socket_path],
@@ -228,6 +303,13 @@ class ZygoteClient:
         self._lock = threading.Lock()
         self._conn = None  # persistent request/reply connection
         self._ready = False
+        # actor classes the zygote confirmed preloaded (children inherit
+        # them via COW): spawns of these ship WITHOUT the cls_blob
+        self._cached_classes: set = set()
+        # phase accounting for the scale bench (fork share of actor
+        # creation): total spawn round trips and seconds spent in them
+        self.spawn_count = 0
+        self.spawn_seconds = 0.0
 
     def _connect(self, timeout: float = 10.0):
         from multiprocessing.connection import Client
@@ -248,6 +330,11 @@ class ZygoteClient:
         if self._proc.poll() is not None:
             return None
         with self._lock:
+            # timed INSIDE the lock: the socket round trip only — on a
+            # 1-CPU burst most wall time is queueing for this lock, which
+            # belongs to the create/dispatch phase, not the fork
+            t_spawn = time.monotonic()
+            self.spawn_count += 1
             # one persistent connection, request/reply in lockstep under
             # the lock (the zygote serves one client at a time; a fork is
             # ~2ms, so serializing here costs nothing). First use waits
@@ -258,8 +345,25 @@ class ZygoteClient:
                 if self._conn is None:
                     return None
                 self._ready = True
-            req: Dict[str, Any] = {"env": env}
+            base = self._base_env
+            req: Dict[str, Any] = {
+                "env_delta": {k: v for k, v in env.items()
+                              if base.get(k) != v},
+                "env_removed": [k for k in base
+                                if k != "RMT_ZYGOTE_AUTHKEY"
+                                and k not in env],
+            }
             if bootstrap is not None:
+                cls_id = bootstrap.get("cls_id")
+                if cls_id is not None and cls_id in _taint_classes:
+                    # this class's preload once initialized a jax
+                    # backend inside a zygote: never preload it again
+                    req["no_preload"] = True
+                elif cls_id is not None \
+                        and cls_id in self._cached_classes \
+                        and bootstrap.get("cls_blob") is not None:
+                    bootstrap = dict(bootstrap)
+                    del bootstrap["cls_blob"]  # zygote preloaded it
                 req["bootstrap"] = bootstrap
             try:
                 self._conn.send(req)
@@ -271,7 +375,20 @@ class ZygoteClient:
                     pass
                 self._conn = None
                 return None
+            self.spawn_seconds += time.monotonic() - t_spawn
+        if reply.get("cls_taint"):
+            # the zygote retired itself rather than fork with a live
+            # backend; blacklist the class and cold-spawn this worker
+            # (get_global() starts a fresh zygote on the next spawn)
+            cid = bootstrap.get("cls_id") if bootstrap else None
+            if cid is not None:
+                _taint_classes.add(cid)
+            return None
         pid = reply.get("pid")
+        if pid and bootstrap is not None and reply.get("cls_cached"):
+            cid = bootstrap.get("cls_id")
+            if cid is not None:
+                self._cached_classes.add(cid)
         return ForkedProc(pid) if pid else None
 
     def close(self) -> None:
@@ -305,6 +422,17 @@ class ZygoteClient:
 # nodes share one, each node agent has its own in its own process.
 _global: Optional[ZygoteClient] = None
 _global_mu = threading.Lock()
+# classes whose preload initialized a jax backend inside a zygote (which
+# then retired itself): survives zygote replacement so the same class
+# can never taint the successor
+_taint_classes: set = set()
+
+
+def peek_global() -> Optional[ZygoteClient]:
+    """The current zygote if one is running — never starts one. For
+    observers (bench phase accounting) that must not pay for, or gate on,
+    a fork server the config may have disabled."""
+    return _global
 
 
 def get_global() -> Optional[ZygoteClient]:

@@ -83,6 +83,12 @@ def run_scale_suite(n_actors: int = 2000, n_tasks: int = 10_000,
             rmt.kill(w)
         time.sleep(1.0)
 
+        from ray_memory_management_tpu.core import zygote
+
+        z = zygote.peek_global()  # observer: never starts a fork server
+        fork0 = (z.spawn_count, z.spawn_seconds) if z else (0, 0.0)
+        boot0 = (sum(nm.boot_count for nm in rt.nodes.values()),
+                 sum(nm.boot_seconds for nm in rt.nodes.values()))
         rates = []
         for _ in range(trials):
             t0 = time.perf_counter()
@@ -95,6 +101,25 @@ def run_scale_suite(n_actors: int = 2000, n_tasks: int = 10_000,
             time.sleep(1.0)  # let kills drain before the next burst
         stats["many_actors_per_s"] = _median_row(rates)
         results["many_actors_per_s"] = stats["many_actors_per_s"]["median"]
+        # per-phase decomposition (VERDICT r4 #4): fork = zygote spawn
+        # round trip; boot = spawn-return -> worker registered (child
+        # interpreter + dial-in); rest = create/dispatch/first-call
+        if zygote.peek_global() is not z:
+            z = None  # zygote replaced mid-burst: counters reset, skip
+        n_forks = (z.spawn_count - fork0[0]) if z else 0
+        n_boots = sum(nm.boot_count for nm in rt.nodes.values()) - boot0[0]
+        per_actor_ms = 1000.0 / stats["many_actors_per_s"]["median"]
+        fork_ms = ((z.spawn_seconds - fork0[1]) / n_forks * 1000
+                   if z and n_forks else None)
+        boot_ms = ((sum(nm.boot_seconds for nm in rt.nodes.values())
+                    - boot0[1]) / n_boots * 1000 if n_boots else None)
+        stats["many_actors_phases"] = {
+            "per_actor_ms": round(per_actor_ms, 2),
+            "fork_ms": round(fork_ms, 2) if fork_ms else None,
+            "boot_to_ready_ms": round(boot_ms, 2) if boot_ms else None,
+            "create_call_ms": round(
+                per_actor_ms - (fork_ms or 0), 2),
+        }
 
         # head peak RSS sampled HERE — after the actor churn, before the
         # broadcast section allocates its 1 GiB payload in this process

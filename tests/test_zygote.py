@@ -125,6 +125,50 @@ def test_forked_proc_liveness_and_kill():
     assert proc2.poll() is not None
 
 
+def test_preload_taint_retires_zygote():
+    """A class blob whose unpickling initializes a jax backend must not be
+    preloaded pre-fork (every later child would inherit a fork-broken
+    PJRT client): the zygote retires itself, the class is blacklisted,
+    and a fresh zygote serves it with the load deferred to the child."""
+    import cloudpickle
+
+    z = zygote.get_global()
+    if z is None:
+        pytest.skip("fork server unavailable")
+
+    def _touch_backend():
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices("cpu")
+        return int
+
+    class _Trigger:
+        def __reduce__(self):
+            return (_touch_backend, ())
+
+    blob = cloudpickle.dumps(_Trigger())
+    env = dict(package_env())
+    env["JAX_PLATFORMS"] = "cpu"
+    cls_id = b"taint-test-cls"
+    boot = {"type": "create_actor", "cls_id": cls_id, "cls_blob": blob}
+    assert z.spawn(env, bootstrap=dict(boot)) is None  # retired, no fork
+    assert cls_id in zygote._taint_classes
+    deadline = time.monotonic() + 10
+    while z._proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert z._proc.poll() is not None  # the tainted zygote exited
+
+    z2 = zygote.get_global()  # fresh replacement
+    assert z2 is not None and z2 is not z
+    proc = z2.spawn(env, bootstrap=dict(boot))  # no_preload: forks fine
+    assert proc is not None and proc.pid > 0
+    assert z2._proc.poll() is None  # replacement survived the spawn
+    proc.kill()
+    zygote._taint_classes.discard(cls_id)
+    zygote.shutdown_global()
+
+
 def test_zygote_death_is_survivable():
     """Killing the fork server must not break worker spawning — the next
     get_global() replaces it, and spawn falls back to cold Popen in the
