@@ -8,7 +8,7 @@ __version__ = "0.1.0"
 from .api import (  # noqa: F401
     init, shutdown, is_initialized, remote, get, put, wait, kill, cancel,
     get_actor, method, ObjectRef, nodes, cluster_resources,
-    available_resources, timeline,
+    available_resources, timeline, cpp_function, cpp_functions,
 )
 from .exceptions import (  # noqa: F401
     RmtError, TaskError, ActorError, ActorDiedError, WorkerCrashedError,

@@ -28,7 +28,8 @@ from .exceptions import RmtError
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "method", "ObjectRef", "nodes",
-    "cluster_resources", "available_resources", "timeline",
+    "cluster_resources", "available_resources", "timeline", "cpp_function",
+    "cpp_functions",
 ]
 
 _INLINE_LIMIT_DEFAULT = 100 * 1024
@@ -171,6 +172,50 @@ def _resolve_strategy(opts) -> Any:
 def _owner():
     """Driver-side refs participate in refcounting; worker-side are bare."""
     return _worker_context.get_runtime()
+
+
+# --------------------------------------------------------- C++ task plane
+class CppFunction:
+    """Handle to a function implemented by a connected C++ executor
+    process (the worker-side C++ API — reference: cpp/include/ray/api.h
+    ``ray::Task(fn).Remote()``; here the executor registers its function
+    names over the client protocol and long-polls for work).
+
+    Args are raw ``bytes`` (the cross-language boundary moves opaque
+    buffers); results come back as ``bytes`` through ordinary
+    ObjectRefs — ``rmt.get`` works unchanged."""
+
+    def __init__(self, name: str, num_returns: int = 1):
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "CppFunction":
+        return CppFunction(self._name, num_returns)
+
+    def remote(self, *args) -> Union[ObjectRef, List[ObjectRef]]:
+        from .client.server import submit_cpp_task
+
+        owner = _owner()
+        if owner is None:
+            raise RmtError("cpp_function requires the in-process driver "
+                           "(thin clients use the call_cpp verb)")
+        oids = submit_cpp_task(
+            self._name, [bytes(a) for a in args],
+            num_returns=self._num_returns, adopt=True)
+        refs = [ObjectRef(oid, owner, adopt=True) for oid in oids]
+        return refs[0] if len(refs) == 1 else refs
+
+
+def cpp_function(name: str) -> CppFunction:
+    """A handle that dispatches to a registered C++ executor function."""
+    return CppFunction(name)
+
+
+def cpp_functions() -> List[str]:
+    """Names currently served by connected C++ executors."""
+    from .client.server import cpp_function_names
+
+    return cpp_function_names()
 
 
 # ------------------------------------------------------------------- actors

@@ -16,6 +16,7 @@ cluster cannot leak resources into each other's lifetime.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from multiprocessing import AuthenticationError
 from multiprocessing.connection import Listener
 from typing import Any, Dict, Optional
@@ -29,7 +30,7 @@ class _JobState:
     """Per-connection resource ledger, reclaimed on disconnect."""
 
     __slots__ = ("job_id", "actors", "pgs", "puts", "refs", "mu", "closed",
-                 "proto_verified")
+                 "proto_verified", "cpp_executors")
 
     def __init__(self, job_id: bytes):
         self.job_id = job_id
@@ -46,6 +47,7 @@ class _JobState:
         # the frontend disconnects (a non-Python frontend has no
         # distributed-refcount participation of its own)
         self.refs: list = []
+        self.cpp_executors: set = set()  # executor ids this conn registered
         self.mu = threading.Lock()
         self.closed = False  # set by _reclaim_job; late tracks reclaim
         # inline instead of landing in an already-drained ledger
@@ -73,6 +75,151 @@ def register_named_function(name: str, fn, **default_opts) -> None:
 
 def unregister_named_function(name: str) -> None:
     _named_functions.pop(name, None)
+
+
+# --------------------------------------------------------- C++ task plane
+# Worker-side C++ story: an EXECUTOR process (native/client Executor,
+# built on librmtclient) registers the names of functions it implements
+# in C++, long-polls for tasks, and returns result bytes. Python (or any
+# frontend) calls them via api.cpp_function(name).remote(...) and gets
+# ordinary ObjectRefs — results deliver through runtime promises. The
+# reference's counterpart is its C++ worker runtime executing
+# RAY_REMOTE-registered functions (cpp/include/ray/api.h ray::Task;
+# cross-language calls move opaque buffers the same way).
+
+
+class _CppExecutor:
+    """One connected C++ executor: registered function names, a pending
+    queue, and the inflight table (for failing tasks on executor death)."""
+
+    __slots__ = ("ex_id", "functions", "queue", "cond", "inflight",
+                 "closed")
+
+    def __init__(self, ex_id: bytes, functions):
+        self.ex_id = ex_id
+        self.functions = set(functions)
+        self.queue: deque = deque()
+        self.cond = threading.Condition()
+        self.inflight: Dict[str, dict] = {}
+        self.closed = False
+
+
+_cpp_lock = threading.Lock()
+_cpp_executors: Dict[bytes, _CppExecutor] = {}
+
+
+def cpp_function_names() -> list:
+    with _cpp_lock:
+        names: set = set()
+        for ex in _cpp_executors.values():
+            if not ex.closed:
+                names |= ex.functions
+    return sorted(names)
+
+
+def submit_cpp_task(name: str, args, num_returns: int = 1,
+                    adopt: bool = False) -> list:
+    """Dispatch a task to the least-loaded C++ executor serving ``name``;
+    returns promise object ids (resolved when the executor replies).
+    ``adopt=True`` pre-registers one local ref per return for an
+    in-process caller's ObjectRef to adopt (the submit_task contract)."""
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        raise RuntimeError("no runtime: init() the cluster first")
+    with _cpp_lock:
+        candidates = [ex for ex in _cpp_executors.values()
+                      if not ex.closed and name in ex.functions]
+    if not candidates:
+        raise RuntimeError(
+            f"no C++ executor serves {name!r}: start one (it registers "
+            "its functions over the client protocol) and retry")
+    ex = min(candidates, key=lambda e: len(e.queue) + len(e.inflight))
+    return_ids = [rt.create_promise() for _ in range(num_returns)]
+    if adopt:
+        for oid in return_ids:
+            rt.add_local_ref(oid)
+    task = {
+        "task_id": return_ids[0].hex(),
+        "name": name,
+        "args": [bytes(a) for a in args],
+        "return_ids": [o.hex() for o in return_ids],
+    }
+    with ex.cond:
+        if not ex.closed:
+            ex.queue.append(task)
+            ex.cond.notify()
+            return return_ids
+    # raced its disconnect: unwind the promises we just minted (their
+    # futures and adopt refs would otherwise leak) and fail fast
+    if adopt:
+        for oid in return_ids:
+            rt.remove_local_ref(oid)  # zero -> deferred free purges it
+    else:
+        rt.free_objects(return_ids)
+    raise RuntimeError(f"C++ executor for {name!r} disconnected")
+
+
+def _cpp_next_task(ex: _CppExecutor, timeout: float) -> Optional[dict]:
+    with ex.cond:
+        if not ex.queue:
+            ex.cond.wait(timeout)
+        if not ex.queue:
+            return None
+        task = ex.queue.popleft()
+        ex.inflight[task["task_id"]] = task
+        return task
+
+
+def _cpp_finish_task(rt, ex: _CppExecutor, task_id: str,
+                     results, error: Optional[str]) -> None:
+    with ex.cond:
+        task = ex.inflight.pop(task_id, None)
+    if task is None:
+        return  # unknown/duplicate completion
+    return_ids = [bytes.fromhex(h) for h in task["return_ids"]]
+    if error is None and len(results or ()) != len(return_ids):
+        error = (f"C++ executor returned {len(results or ())} results "
+                 f"for {len(return_ids)} return ids")
+    if error is not None:
+        from ..exceptions import TaskError
+
+        exc = TaskError(task["name"], RuntimeError(error))
+        for oid in return_ids:
+            rt.resolve_promise(oid, error=exc)
+        return
+    try:
+        for oid, data in zip(return_ids, results):
+            rt.resolve_promise(oid, value=bytes(data))
+    except Exception as e:  # noqa: BLE001 — e.g. store full storing a
+        # large result: the task is already out of inflight, so the
+        # executor-death failsafe can never reach these promises — fail
+        # them HERE or the caller's get blocks forever
+        from ..exceptions import TaskError
+
+        exc = TaskError(task["name"], e)
+        for oid in return_ids:
+            rt.resolve_promise(oid, error=exc)
+        raise
+
+
+def _cpp_close_executor(rt, ex_id: bytes) -> None:
+    """Executor disconnected: fail everything it held, deregister it."""
+    with _cpp_lock:
+        ex = _cpp_executors.pop(ex_id, None)
+    if ex is None:
+        return
+    from ..exceptions import TaskError
+
+    with ex.cond:
+        ex.closed = True
+        orphans = list(ex.queue) + list(ex.inflight.values())
+        ex.queue.clear()
+        ex.inflight.clear()
+    for task in orphans:
+        exc = TaskError(task["name"],
+                        RuntimeError("C++ executor disconnected"))
+        for h in task["return_ids"]:
+            rt.resolve_promise(bytes.fromhex(h), error=exc)
 
 
 class ClusterServer:
@@ -149,10 +296,15 @@ class ClusterServer:
             job.closed = True
             actors, pgs, puts = list(job.actors), list(job.pgs), \
                 list(job.puts)
+            executors = list(job.cpp_executors)
             job.actors.clear()
             job.pgs.clear()
             job.puts.clear()
+            job.cpp_executors.clear()
             job.refs.clear()  # drop call_named returns: refcount frees them
+        for ex_id in executors:
+            # fail its queued/inflight tasks, then deregister it
+            _cpp_close_executor(rt, ex_id)
         for aid in actors:
             self._reclaim_one("actors", aid)
         for pg_id in pgs:
@@ -318,6 +470,48 @@ class ClusterServer:
                             f"{type(v).__name__}; rich values need a "
                             "Python client")
                 reply["values"] = out
+            elif mtype == "register_cpp_executor":
+                import os as _os
+
+                ex_id = _os.urandom(16)
+                ex = _CppExecutor(ex_id, [str(n) for n in msg["functions"]])
+                with _cpp_lock:
+                    _cpp_executors[ex_id] = ex
+                with job.mu:
+                    if not job.closed:
+                        job.cpp_executors.add(ex_id)
+                        ex_id_ok = True
+                    else:
+                        ex_id_ok = False
+                if not ex_id_ok:  # conn died mid-register: deregister
+                    _cpp_close_executor(rt, ex_id)
+                    raise OSError("connection closed during registration")
+                reply["executor_id"] = ex_id
+            elif mtype == "next_cpp_task":
+                with _cpp_lock:
+                    ex = _cpp_executors.get(bytes(msg["executor_id"]))
+                if ex is None:
+                    raise KeyError("unknown executor id")
+                timeout = min(float(msg.get("timeout", 10.0)), 60.0)
+                reply["task"] = _cpp_next_task(ex, timeout)
+            elif mtype == "cpp_task_done":
+                with _cpp_lock:
+                    ex = _cpp_executors.get(bytes(msg["executor_id"]))
+                if ex is None:
+                    raise KeyError("unknown executor id")
+                _cpp_finish_task(rt, ex, str(msg["task_id"]),
+                                 msg.get("results"), msg.get("err"))
+            elif mtype == "call_cpp":
+                oids = submit_cpp_task(
+                    str(msg["name"]), msg.get("args", []),
+                    int(msg.get("num_returns", 1)))
+                for oid in oids:
+                    # promise returns pin like puts: freed when this
+                    # frontend disconnects (or frees them explicitly)
+                    track("puts", oid)
+                reply["return_ids"] = oids
+            elif mtype == "list_cpp":
+                reply["names"] = cpp_function_names()
             elif mtype == "ping":
                 from ..config import WIRE_PROTOCOL_VERSION
 

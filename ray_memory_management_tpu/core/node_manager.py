@@ -31,6 +31,11 @@ from .object_store import NodeObjectStore
 from .resources import CPU, NodeResources, Resources, TPU
 from .task_spec import TaskSpec
 
+# shared zero request for placement-group tasks (their resources were
+# already deducted at bundle reservation); Resources is immutable-by-
+# convention so one instance serves every dispatch round
+_EMPTY_REQ = Resources({})
+
 
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "node_id", "ready", "idle",
@@ -445,9 +450,8 @@ class NodeManager:
                 spec = self.queue[0]
                 # PG tasks draw from their bundle's reservation, which the
                 # scheduler already deducted from this node's pool
-                req = Resources(
-                    {} if spec.placement is not None else spec.resources
-                )
+                req = (_EMPTY_REQ if spec.placement is not None
+                       else spec.req)
                 handle = None
                 lease = False
                 conda_spec = (spec.runtime_env or {}).get("conda") \

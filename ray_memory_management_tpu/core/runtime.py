@@ -321,6 +321,11 @@ class Runtime:
         self._materialize_futs: Dict[bytes, Future] = {}
         self._log_tails: Dict[Any, bytes] = {}  # worker id -> partial line
         self.futures: Dict[bytes, Future] = {}
+        # live promise ids (create_promise): freeing one PURGES its
+        # pending future (a task future must outlive frees for its
+        # waiters; a freed promise means the caller is gone and a late
+        # external resolution must be dropped, not stored ownerless)
+        self._promises: Set[bytes] = set()
         self.tasks: Dict[bytes, _TaskRecord] = {}
         self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
         self.local_refs: Dict[bytes, int] = defaultdict(int)
@@ -1203,9 +1208,7 @@ class Runtime:
                 return
         else:
             try:
-                node_id = self.scheduler.pick_node(
-                    Resources(spec.resources), strategy
-                )
+                node_id = self.scheduler.pick_node(spec.req, strategy)
             except ValueError as e:
                 self._fail_task(spec, TaskError(spec.name, None, str(e)))
                 return
@@ -2332,6 +2335,46 @@ class Runtime:
             self.futures[oid] = fut
         return oid
 
+    # ------------------------------------------------------------- promises
+    def create_promise(self) -> bytes:
+        """Pre-allocate an object id whose value an EXTERNAL executor
+        delivers later (the cross-language task plane: C++ executors
+        return results for ids minted before dispatch). Gets on the id
+        park on the unresolved future exactly like a task return; no
+        lineage — a lost promise is failed by its broker, not recovered."""
+        oid = ObjectID.for_put().binary()
+        with self._lock:
+            self.futures[oid] = _SlimFuture()
+            self._promises.add(oid)
+        return oid
+
+    def resolve_promise(self, oid: bytes, value: Any = None,
+                        error: Optional[Exception] = None) -> None:
+        """Deliver (or fail) a promise created by :meth:`create_promise`."""
+        with self._lock:
+            if oid not in self._promises:
+                return  # promise freed (caller gone): drop the late result
+        if error is None:
+            data = ser.serialize(value)
+            if data.total_size <= self.config.max_direct_call_object_size:
+                with self._lock:
+                    self.memory_store[oid] = data.to_bytes()
+            else:
+                self._flush_deferred_frees()  # see put_object
+                nm = self.head_node()
+                nm.store.put_serialized(oid, data)
+                self.gcs.add_object_location(oid, nm.node_id)
+        with self._lock:
+            fut = self.futures.get(oid)
+            if fut is None:
+                fut = self.futures[oid] = _SlimFuture()
+        if fut.done():
+            return  # double resolve: first delivery wins
+        if error is None:
+            fut.set_result(True)
+        else:
+            fut.set_exception(error)
+
     def put_serialized_arg(self, data: ser.SerializedObject) -> bytes:
         """Promote an oversized call argument to a store object (the
         plasma-promotion path of serialization.py:411 in the reference)."""
@@ -2776,6 +2819,13 @@ class Runtime:
                 task_id = self.lineage.get(oid)
                 if task_id is not None:
                     self._try_prune_record_locked(task_id)
+                elif oid in self._promises:
+                    # freed promise: the caller is gone, so purge even a
+                    # PENDING future — a late external resolution must
+                    # find nothing and drop its result (resolve_promise
+                    # checks _promises), not store an ownerless object
+                    self._promises.discard(oid)
+                    self.futures.pop(oid, None)
                 else:
                     # a put object: no lineage, just the settled future
                     fut = self.futures.get(oid)

@@ -22,7 +22,7 @@ class TaskSpec:
         "return_ids", "resources", "strategy", "max_retries",
         "retry_exceptions", "actor_id", "method", "seq",
         "runtime_env", "placement", "depth", "_ref_deps_cache",
-        "_conda_key",
+        "_conda_key", "_req_cache",
     )
 
     def __init__(
@@ -66,6 +66,20 @@ class TaskSpec:
         # memoized conda-env key: computed once at first dispatch, not
         # re-hashed under the node lock every dispatch round
         self._conda_key: Optional[str] = None
+        self._req_cache = None
+
+    @property
+    def req(self):
+        """The task's resource request as a ``Resources``, built once:
+        scheduling + every dispatch round rebuilt it from the dict, which
+        showed in the task hot path. Read-only by convention — dispatch
+        stores it as a worker's lease and compares leases by value."""
+        r = self._req_cache
+        if r is None:
+            from .resources import Resources
+
+            r = self._req_cache = Resources(self.resources)
+        return r
 
     @property
     def ref_deps(self) -> List[bytes]:

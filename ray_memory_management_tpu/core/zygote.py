@@ -83,11 +83,16 @@ def serve(socket_path: str, authkey: bytes) -> None:
 
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap forked workers
     listener = Listener(socket_path, family="AF_UNIX", authkey=authkey)
-    # frozen child baseline for the env-delta protocol: clients compute
-    # deltas against the env they LAUNCHED the zygote with, so children
-    # must reset to that exact snapshot — resetting to the live
-    # os.environ instead would leak any environ drift (e.g. a preloaded
-    # class's import setting XLA_FLAGS) into every later worker
+    # Child baseline for the env-delta protocol. The CLIENT ships its
+    # _base_env with each fresh connection ("base_env" key on the first
+    # frame): children must reset to the exact dict deltas were computed
+    # against. Neither the zygote's launch environ nor a serve-time
+    # snapshot can stand in for it — this interpreter's own startup
+    # (sitecustomize setting JAX_PLATFORMS for the TPU image) and any
+    # preloaded class's imports mutate os.environ before/after serve
+    # begins, and that drift must never leak into workers. The startup
+    # snapshot below is only the fallback for a client that never sent
+    # one (then deltas were computed against the same launch env).
     base_env = {k: v for k, v in os.environ.items()
                 if k != "RMT_ZYGOTE_AUTHKEY"}
 
@@ -113,6 +118,63 @@ def serve(socket_path: str, authkey: bytes) -> None:
     # every future child a fork-broken backend), so a load that trips
     # the guard below retires this zygote: the client cold-spawns the
     # current worker, blacklists the class, and starts a fresh zygote.
+    def handle_one(req: dict) -> dict:
+        """Serve one spawn request: preload (with the taint guard), fork,
+        and — in the parent — return the reply dict. The forked child
+        never returns (it becomes the worker and _exits)."""
+        bootstrap = req.get("bootstrap")
+        cls_cached = False
+        if bootstrap is not None and not req.get("no_preload"):
+            cls_id = bootstrap.get("cls_id")
+            if cls_id is not None:
+                if cls_id in worker.PRELOADED_CLASSES:
+                    cls_cached = True
+                elif bootstrap.get("cls_blob") is not None:
+                    try:
+                        worker.PRELOADED_CLASSES[cls_id] = \
+                            cloudpickle.loads(bootstrap["cls_blob"])
+                        cls_cached = True
+                    except Exception:  # noqa: BLE001 — child loads
+                        pass           # it from the blob as before
+                    if jax_backend_live():
+                        # the load initialized a backend in THIS
+                        # process: forking now is unsafe. Retire.
+                        worker.PRELOADED_CLASSES.pop(cls_id, None)
+                        return {"cls_taint": True}
+        try:
+            pid = os.fork()
+        except OSError as e:
+            return {"error": repr(e)}
+        if pid == 0:
+            # --- child: become the worker ---------------------------------
+            try:
+                conn.close()
+                listener.close()
+                signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+                if "env" in req:
+                    os.environ.clear()
+                    os.environ.update(req["env"])
+                else:
+                    # delta protocol: the child resets to the FROZEN
+                    # launch snapshot (the dict the client computed its
+                    # delta against) — per spawn only the handful of
+                    # per-worker vars cross the socket instead of the
+                    # full ~3KB environment
+                    os.environ.clear()
+                    os.environ.update(base_env)
+                    for k in req.get("env_removed") or ():
+                        os.environ.pop(k, None)
+                    os.environ.update(req.get("env_delta") or {})
+                worker_main._bootstrap = bootstrap
+                worker_main.main()
+            except BaseException:  # noqa: BLE001 — never unwind into
+                os._exit(1)        # the zygote's stack in a fork child
+            os._exit(0)
+        # --- parent -------------------------------------------------------
+        # cls_cached acks the preload: the client then strips the
+        # multi-KB cls_blob from subsequent spawns of this class
+        return {"pid": pid, "cls_cached": cls_cached}
+
     while True:
         try:
             conn = listener.accept()
@@ -132,76 +194,36 @@ def serve(socket_path: str, authkey: bytes) -> None:
                 except OSError:
                     pass
                 return
-            bootstrap = msg.get("bootstrap")
-            cls_cached = False
-            if bootstrap is not None and not msg.get("no_preload"):
-                cls_id = bootstrap.get("cls_id")
-                if cls_id is not None:
-                    if cls_id in worker.PRELOADED_CLASSES:
-                        cls_cached = True
-                    elif bootstrap.get("cls_blob") is not None:
-                        try:
-                            worker.PRELOADED_CLASSES[cls_id] = \
-                                cloudpickle.loads(bootstrap["cls_blob"])
-                            cls_cached = True
-                        except Exception:  # noqa: BLE001 — child loads
-                            pass           # it from the blob as before
-                        if jax_backend_live():
-                            # the load initialized a backend in THIS
-                            # process: forking now is unsafe. Retire.
-                            worker.PRELOADED_CLASSES.pop(cls_id, None)
-                            try:
-                                conn.send({"cls_taint": True})
-                            except (OSError, BrokenPipeError):
-                                pass
-                            conn.close()
-                            try:
-                                listener.close()
-                                os.unlink(socket_path)
-                            except OSError:
-                                pass
-                            return
+            if "base_env" in msg:
+                base_env = {k: v for k, v in msg["base_env"].items()
+                            if k != "RMT_ZYGOTE_AUTHKEY"}
+            # batched spawns: concurrent client spawners combine into one
+            # frame — a 2,000-actor burst pays one socket round trip (two
+            # scheduling handoffs on a contended CPU) per BATCH of forks,
+            # not per fork
+            reqs = msg["spawns"] if "spawns" in msg else [msg]
+            replies = []
+            retire = False
+            for req in reqs:
+                rep = handle_one(req)
+                replies.append(rep)
+                if rep.get("cls_taint"):
+                    retire = True  # unserved tail: client cold-spawns it
+                    break
+            out = {"replies": replies} if "spawns" in msg else replies[0]
             try:
-                pid = os.fork()
-            except OSError as e:
-                try:
-                    conn.send({"error": repr(e)})
-                except (OSError, BrokenPipeError):
-                    pass
-                continue
-            if pid == 0:
-                # --- child: become the worker -----------------------------
-                try:
-                    conn.close()
-                    listener.close()
-                    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
-                    if "env" in msg:
-                        os.environ.clear()
-                        os.environ.update(msg["env"])
-                    else:
-                        # delta protocol: the child resets to the FROZEN
-                        # launch snapshot (the dict the client computed
-                        # its delta against) — per spawn only the
-                        # handful of per-worker vars cross the socket
-                        # instead of the full ~3KB environment
-                        os.environ.clear()
-                        os.environ.update(base_env)
-                        for k in msg.get("env_removed") or ():
-                            os.environ.pop(k, None)
-                        os.environ.update(msg.get("env_delta") or {})
-                    worker_main._bootstrap = bootstrap
-                    worker_main.main()
-                except BaseException:  # noqa: BLE001 — never unwind into
-                    os._exit(1)        # the zygote's stack in a fork child
-                os._exit(0)
-            # --- parent --------------------------------------------------
-            try:
-                # cls_cached acks the preload: the client then strips the
-                # multi-KB cls_blob from subsequent spawns of this class
-                conn.send({"pid": pid, "cls_cached": cls_cached})
+                conn.send(out)
             except (OSError, BrokenPipeError):
                 conn.close()
                 break
+            if retire:
+                conn.close()
+                try:
+                    listener.close()
+                    os.unlink(socket_path)
+                except OSError:
+                    pass
+                return
 
 
 class ForkedProc:
@@ -271,6 +293,17 @@ class ForkedProc:
             self.returncode = self.returncode or 1
 
 
+class _SpawnEntry:
+    """One queued spawn request in the client's combining queue."""
+
+    __slots__ = ("req", "reply", "done")
+
+    def __init__(self, req: dict):
+        self.req = req
+        self.reply: Optional[dict] = None
+        self.done = threading.Event()
+
+
 class ZygoteClient:
     """Owns one zygote process and requests forks from it.
 
@@ -293,6 +326,14 @@ class ZygoteClient:
         for var in Config().cpu_worker_env_drop.split(","):
             if var:
                 env.pop(var.strip(), None)
+        # CPU platform, pinned: the zygote only ever forks CPU workers
+        # (spawn_worker_process gates on JAX_PLATFORMS == "cpu"; TPU
+        # workers always cold-spawn), and jax CAPTURES the platform list
+        # at import — a class preload whose module chain imports jax
+        # under any other value would poison every later child with a
+        # platform no env reset can undo (the delta protocol resets
+        # os.environ, not an already-imported jax's captured config)
+        env["JAX_PLATFORMS"] = "cpu"
         # children inherit this exact dict; spawn() ships only the delta
         self._base_env = dict(env)
         self._proc = subprocess.Popen(
@@ -303,11 +344,16 @@ class ZygoteClient:
         self._lock = threading.Lock()
         self._conn = None  # persistent request/reply connection
         self._ready = False
+        # combining queue: concurrent spawners enqueue requests; whoever
+        # holds the lock ships EVERY queued request as one batch frame
+        self._q_mu = threading.Lock()
+        self._q: list = []
         # actor classes the zygote confirmed preloaded (children inherit
         # them via COW): spawns of these ship WITHOUT the cls_blob
         self._cached_classes: set = set()
         # phase accounting for the scale bench (fork share of actor
-        # creation): total spawn round trips and seconds spent in them
+        # creation): total forks requested and seconds spent in batch
+        # round trips (seconds/forks = amortized per-fork round trip)
         self.spawn_count = 0
         self.spawn_seconds = 0.0
 
@@ -329,53 +375,45 @@ class ZygoteClient:
               bootstrap: Optional[dict] = None) -> Optional[ForkedProc]:
         if self._proc.poll() is not None:
             return None
-        with self._lock:
-            # timed INSIDE the lock: the socket round trip only — on a
-            # 1-CPU burst most wall time is queueing for this lock, which
-            # belongs to the create/dispatch phase, not the fork
-            t_spawn = time.monotonic()
-            self.spawn_count += 1
-            # one persistent connection, request/reply in lockstep under
-            # the lock (the zygote serves one client at a time; a fork is
-            # ~2ms, so serializing here costs nothing). First use waits
-            # for the zygote to finish its preload.
-            if self._conn is None:
-                self._conn = self._connect(
-                    timeout=1.0 if self._ready else 15.0)
-                if self._conn is None:
-                    return None
-                self._ready = True
-            base = self._base_env
-            req: Dict[str, Any] = {
-                "env_delta": {k: v for k, v in env.items()
-                              if base.get(k) != v},
-                "env_removed": [k for k in base
-                                if k != "RMT_ZYGOTE_AUTHKEY"
-                                and k not in env],
-            }
-            if bootstrap is not None:
-                cls_id = bootstrap.get("cls_id")
-                if cls_id is not None and cls_id in _taint_classes:
-                    # this class's preload once initialized a jax
-                    # backend inside a zygote: never preload it again
-                    req["no_preload"] = True
-                elif cls_id is not None \
-                        and cls_id in self._cached_classes \
-                        and bootstrap.get("cls_blob") is not None:
-                    bootstrap = dict(bootstrap)
-                    del bootstrap["cls_blob"]  # zygote preloaded it
-                req["bootstrap"] = bootstrap
-            try:
-                self._conn.send(req)
-                reply = self._conn.recv()
-            except (EOFError, OSError, BrokenPipeError):
+        base = self._base_env
+        req: Dict[str, Any] = {
+            "env_delta": {k: v for k, v in env.items()
+                          if base.get(k) != v},
+            "env_removed": [k for k in base
+                            if k != "RMT_ZYGOTE_AUTHKEY"
+                            and k not in env],
+        }
+        if bootstrap is not None:
+            cls_id = bootstrap.get("cls_id")
+            if cls_id is not None and cls_id in _taint_classes:
+                # this class's preload once initialized a jax backend
+                # inside a zygote: never preload it again
+                req["no_preload"] = True
+            elif cls_id is not None \
+                    and cls_id in self._cached_classes \
+                    and bootstrap.get("cls_blob") is not None:
+                bootstrap = dict(bootstrap)
+                del bootstrap["cls_blob"]  # zygote preloaded it
+            req["bootstrap"] = bootstrap
+        # combining: enqueue, then either become the leader (ship every
+        # queued request as ONE batch frame) or wait for a leader to ship
+        # ours. An actor burst's concurrent spawners pay one socket round
+        # trip per batch instead of one per fork.
+        entry = _SpawnEntry(req)
+        with self._q_mu:
+            self._q.append(entry)
+        while not entry.done.is_set():
+            if self._lock.acquire(timeout=0.02):
                 try:
-                    self._conn.close()
-                except OSError:
-                    pass
-                self._conn = None
-                return None
-            self.spawn_seconds += time.monotonic() - t_spawn
+                    if not entry.done.is_set():
+                        self._serve_batch_locked()
+                finally:
+                    self._lock.release()
+            else:
+                entry.done.wait(0.05)
+        reply = entry.reply
+        if reply is None:
+            return None
         if reply.get("cls_taint"):
             # the zygote retired itself rather than fork with a live
             # backend; blacklist the class and cold-spawn this worker
@@ -390,6 +428,61 @@ class ZygoteClient:
             if cid is not None:
                 self._cached_classes.add(cid)
         return ForkedProc(pid) if pid else None
+
+    def _serve_batch_locked(self) -> None:
+        """With the leader lock held: ship every queued spawn request as
+        one frame, distribute replies, wake the waiters. Entries the
+        zygote did not serve (connection loss, taint retirement mid-
+        batch, ANY unexpected error) resolve to None and their callers
+        cold-spawn — a leader must never strand the spawners riding its
+        batch, so nothing here may raise once the queue is drained."""
+        with self._q_mu:
+            batch = self._q
+            self._q = []
+        if not batch:
+            return
+        try:
+            self._serve_batch(batch)
+        finally:
+            for e in batch:  # idempotent: already-served entries are set
+                if not e.done.is_set():
+                    e.reply = None
+                    e.done.set()
+
+    def _serve_batch(self, batch) -> None:
+        t0 = time.monotonic()
+        if self._proc.poll() is not None:
+            return
+        # first use waits for the zygote to finish its import preload
+        frame = {"spawns": [e.req for e in batch]}
+        if self._conn is None:
+            try:
+                self._conn = self._connect(
+                    timeout=1.0 if self._ready else 15.0)
+            except Exception:  # noqa: BLE001 — e.g. AuthenticationError
+                self._conn = None
+            if self._conn is None:
+                return
+            self._ready = True
+            # fresh connection: ship the baseline the deltas are computed
+            # against — the zygote's own environ has drifted from it by
+            # interpreter startup (sitecustomize) and preload imports
+            frame["base_env"] = self._base_env
+        try:
+            self._conn.send(frame)
+            replies = self._conn.recv()["replies"]
+        except Exception:  # noqa: BLE001 — conn loss, protocol drift:
+            try:                          # reset; the batch cold-spawns
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+            return
+        self.spawn_seconds += time.monotonic() - t0
+        self.spawn_count += len(batch)
+        for i, e in enumerate(batch):
+            e.reply = replies[i] if i < len(replies) else None
+            e.done.set()
 
     def close(self) -> None:
         if self._proc.poll() is None:

@@ -71,8 +71,19 @@ class BaseID:
         self._hash = hash(binary)
 
     @classmethod
+    def _wrap(cls, binary: bytes):
+        """Construct from bytes KNOWN to be a valid 16-byte ID (minted by
+        this module). Skips __init__'s validation — ID minting runs twice
+        per task on the submission hot path, where the isinstance/length
+        checks are pure overhead."""
+        self = object.__new__(cls)
+        self._binary = binary
+        self._hash = hash(binary)
+        return self
+
+    @classmethod
     def from_random(cls):
-        return cls(_entropy.take(_ID_SIZE))
+        return cls._wrap(_entropy.take(_ID_SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -136,7 +147,7 @@ class TaskID(BaseID):
         embed a return index there and still map back to this task via
         :meth:`ObjectID.task_id`.
         """
-        return cls(
+        return cls._wrap(
             job_id.binary()[:4]
             + _entropy.take(_ID_SIZE - 4 - _INDEX_BYTES)
             + b"\x00" * _INDEX_BYTES
@@ -152,12 +163,12 @@ class ObjectID(BaseID):
         re-derives the same IDs when the task is re-executed.
         """
         prefix = task_id.binary()[: _ID_SIZE - _INDEX_BYTES]
-        return cls(prefix + index.to_bytes(_INDEX_BYTES, "little"))
+        return cls._wrap(prefix + index.to_bytes(_INDEX_BYTES, "little"))
 
     @classmethod
     def for_put(cls) -> "ObjectID":
         """Random ID for a driver/worker ``put`` (no lineage)."""
-        return cls(_entropy.take(_ID_SIZE))
+        return cls._wrap(_entropy.take(_ID_SIZE))
 
     def task_id(self) -> TaskID:
         """The producing task's ID prefix (valid only for return objects)."""

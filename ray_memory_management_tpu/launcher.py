@@ -573,12 +573,19 @@ def _wait_for_cpus(client_address: str, want_cpus: float,
     until every launched node has registered its CPUs."""
     from multiprocessing.connection import Client as _Client
 
+    from .config import WIRE_PROTOCOL_VERSION
+
     host, port = client_address.rsplit(":", 1)
     deadline = time.monotonic() + max(5.0, timeout)
     while time.monotonic() < deadline:
         try:
             conn = _Client((host, int(port)), authkey=b"rmt-client")
             try:
+                # every verb is refused until the versioned ping lands
+                # (the wire-protocol gate all frontends pass through)
+                conn.send({"type": "ping", "req_id": 0,
+                           "proto": WIRE_PROTOCOL_VERSION})
+                conn.recv()
                 conn.send({"type": "cluster_resources", "req_id": 1})
                 reply = conn.recv()
             finally:

@@ -793,4 +793,70 @@ std::map<std::string, double> Client::ClusterResources() {
   return out;
 }
 
+PyVal Client::Rpc(std::map<std::string, PyVal> msg) {
+  return Request(std::move(msg));
+}
+
+// ------------------------------------------------------------- Executor
+
+Executor::Executor(const std::string& host, int port,
+                   const std::string& authkey)
+    : client_(host, port, authkey) {}
+
+void Executor::Register(const std::string& name, Fn fn) {
+  fns_[name] = std::move(fn);
+}
+
+void Executor::Start() {
+  if (started_) return;
+  std::vector<PyVal> names;
+  for (const auto& kv : fns_) names.push_back(PvStr(kv.first));
+  std::map<std::string, PyVal> msg;
+  msg["type"] = PvStr("register_cpp_executor");
+  msg["functions"] = PvList(std::move(names));
+  PyVal reply = client_.Rpc(std::move(msg));
+  ex_id_ = reply.dict.at("executor_id").bytes();
+  started_ = true;
+}
+
+bool Executor::ServeOne(double poll_timeout_s) {
+  if (!started_) Start();
+  std::map<std::string, PyVal> poll;
+  poll["type"] = PvStr("next_cpp_task");
+  poll["executor_id"] = PvBytes(ex_id_);
+  poll["timeout"] = PvFloat(poll_timeout_s);
+  PyVal reply = client_.Rpc(std::move(poll));
+  const PyVal& task = reply.dict.at("task");
+  if (task.is_none()) return false;
+
+  const std::string& name = task.dict.at("name").s;
+  std::vector<std::string> args;
+  for (const auto& a : task.dict.at("args").list) args.push_back(a.bytes());
+
+  std::map<std::string, PyVal> done;
+  done["type"] = PvStr("cpp_task_done");
+  done["executor_id"] = PvBytes(ex_id_);
+  done["task_id"] = PvStr(task.dict.at("task_id").s);
+  auto it = fns_.find(name);
+  if (it == fns_.end()) {
+    done["err"] = PvStr("executor has no function '" + name + "'");
+  } else {
+    try {
+      std::vector<std::string> results = it->second(args);
+      std::vector<PyVal> out;
+      out.reserve(results.size());
+      for (auto& r : results) out.push_back(PvBytes(std::move(r)));
+      done["results"] = PvList(std::move(out));
+    } catch (const std::exception& e) {
+      done["err"] = PvStr(std::string("C++ exception: ") + e.what());
+    }
+  }
+  client_.Rpc(std::move(done));
+  return true;
+}
+
+void Executor::ServeForever() {
+  for (;;) ServeOne(5.0);  // connection loss -> ClientError unwinds out
+}
+
 }  // namespace rmt

@@ -21,6 +21,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <map>
 #include <memory>
 #include <stdexcept>
@@ -92,6 +93,11 @@ class Client {
   std::map<std::string, double> ClusterResources();
   void Close();
 
+  // Generic verb escape hatch: send one request dict (a "type" entry
+  // names the verb), return the reply. Raises ClientError when the reply
+  // carries a server-side error. The Executor below is built on this.
+  PyVal Rpc(std::map<std::string, PyVal> msg);
+
  private:
   PyVal Request(std::map<std::string, PyVal> msg);
   void SendFrame(const std::string& payload);
@@ -100,6 +106,44 @@ class Client {
 
   int fd_ = -1;
   int64_t req_counter_ = 0;
+};
+
+// Worker-side C++ API: implement task functions IN C++ and serve them to
+// the cluster. The executor registers its function names over the client
+// protocol (client/server.py register_cpp_executor), long-polls for
+// dispatched tasks, runs them, and returns result bytes; Python callers
+// use api.cpp_function(name).remote(...) and ordinary ObjectRefs.
+// Counterpart of the reference's C++ worker executing RAY_REMOTE
+// functions (cpp/include/ray/api.h ray::Task(fn).Remote()) — re-drawn
+// over this runtime's authenticated wire protocol with the same
+// opaque-bytes cross-language boundary as the thin client.
+class Executor {
+ public:
+  // A task function: raw bytes args in, one result (or num_returns
+  // results) out. Throwing std::exception fails the task cluster-side
+  // with the exception text.
+  using Fn = std::function<std::vector<std::string>(
+      const std::vector<std::string>&)>;
+
+  Executor(const std::string& host, int port,
+           const std::string& authkey = "rmt-client");
+
+  // Register before Start(); name is what Python callers use.
+  void Register(const std::string& name, Fn fn);
+  // Announce the registered functions to the cluster. Called implicitly
+  // by the first ServeOne/ServeForever.
+  void Start();
+  // One long-poll round: waits up to poll_timeout_s for a task, runs it,
+  // replies. Returns true if a task was served.
+  bool ServeOne(double poll_timeout_s = 5.0);
+  // Serve until the connection drops (ClientError propagates).
+  void ServeForever();
+
+ private:
+  Client client_;
+  std::map<std::string, Fn> fns_;
+  std::string ex_id_;
+  bool started_ = false;
 };
 
 // Helpers for building request values (exposed for tests).

@@ -16,6 +16,7 @@ from .connectors import (  # noqa: F401
     register_connector,
 )
 from .appo import APPO, APPOConfig  # noqa: F401
+from .ars import ARS, ARSConfig  # noqa: F401
 from .bandit import (  # noqa: F401
     BanditLinTS,
     BanditLinTSConfig,
@@ -40,6 +41,7 @@ from .env import (  # noqa: F401
     register_env,
 )
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .maddpg import MADDPG, MADDPGConfig, Rendezvous  # noqa: F401
 from .qmix import QMix, QMixConfig, TwoStepCoop  # noqa: F401
 from .offline import (  # noqa: F401
     BC,

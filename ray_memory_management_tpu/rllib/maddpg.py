@@ -1,0 +1,398 @@
+"""MADDPG: multi-agent DDPG with centralized critics (Lowe et al. 2017).
+
+The reference's rllib/algorithms/maddpg/maddpg.py: each agent i trains a
+deterministic actor mu_i(o_i) plus a CENTRALIZED critic
+Q_i(o_1..o_N, a_1..a_N) that sees every agent's observation and action —
+the critic is only needed at training time, so execution stays fully
+decentralized. Off-policy over a joint replay buffer; in the actor step
+agent i's own action is replaced by mu_i(o_i) while the other agents'
+actions come from the batch (the MADDPG gradient).
+
+TPU-first redesign: the reference keeps N independent policy graphs and
+loops over them; here the N (homogeneous-shaped) agents' parameters are
+STACKED along a leading axis and every per-agent computation — target
+actions, critic TD steps, actor gradients, polyak syncs — is vmapped, so
+the whole N-agent update is ONE jit'd XLA program whose batch dimension
+covers agents x minibatch (the MXU sees [N*B, ...] matmuls instead of N
+small graphs).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import register_env
+from .models import mlp_apply, mlp_init
+from .multi_agent import MultiAgentEnv
+from .replay import ReplayBuffer
+
+
+class Rendezvous(MultiAgentEnv):
+    """Continuous cooperative rendezvous: N point agents on the [-1,1]^2
+    plane apply velocity actions and share the reward
+    ``-mean pairwise distance`` (+ a success bonus when gathered) — the
+    cooperative-navigation shape of the MADDPG paper's particle envs
+    (reference rllib: the MPE simple_spread family), reduced to its
+    learning-signal core."""
+
+    def __init__(self, n_agents: int = 2, max_episode_steps: int = 50,
+                 gather_radius: float = 0.1):
+        self.agent_ids = [f"agent_{i}" for i in range(n_agents)]
+        self.n_agents = n_agents
+        self.observation_dim = 2 * n_agents  # own pos first, then others
+        self.action_dim = 2
+        self.action_bound = 1.0
+        self.max_episode_steps = max_episode_steps
+        self.gather_radius = gather_radius
+        self._pos = np.zeros((n_agents, 2), np.float32)
+        self._t = 0
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, aid in enumerate(self.agent_ids):
+            others = np.delete(self._pos, i, axis=0).ravel()
+            out[aid] = np.concatenate([self._pos[i], others]).astype(
+                np.float32)
+        return out
+
+    def _mean_pairwise(self) -> float:
+        d = self._pos[:, None, :] - self._pos[None, :, :]
+        dist = np.sqrt((d * d).sum(-1) + 1e-12)
+        n = self.n_agents
+        return float(dist.sum() / (n * (n - 1))) if n > 1 else 0.0
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(-1, 1, (self.n_agents, 2)).astype(
+            np.float32)
+        self._t = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, Any]):
+        self._t += 1
+        for i, aid in enumerate(self.agent_ids):
+            a = np.clip(np.asarray(actions[aid], np.float32), -1.0, 1.0)
+            self._pos[i] = np.clip(self._pos[i] + 0.1 * a, -1.0, 1.0)
+        spread = self._mean_pairwise()
+        gathered = spread < self.gather_radius
+        r = -spread + (5.0 if gathered else 0.0)
+        rewards = {aid: r for aid in self.agent_ids}
+        term = bool(gathered)
+        trunc = self._t >= self.max_episode_steps
+        terms = {aid: term for aid in self.agent_ids}
+        truncs = {aid: trunc for aid in self.agent_ids}
+        terms["__all__"] = term
+        truncs["__all__"] = trunc
+        return self._obs(), rewards, terms, truncs, {}
+
+
+register_env("Rendezvous", Rendezvous)
+
+
+def maddpg_init(rng, n_agents: int, obs_dim: int, act_dim: int,
+                hidden=(64, 64)):
+    """Per-agent actor + centralized critic, STACKED along agent axis 0
+    (every leaf is [N, ...]); built by vmapping the initializer over
+    per-agent keys."""
+    import jax
+
+    joint = n_agents * (obs_dim + act_dim)
+
+    def one(key):
+        k_pi, k_q = jax.random.split(key)
+        return {"pi": mlp_init(k_pi, [obs_dim, *hidden, act_dim]),
+                "q": mlp_init(k_q, [joint, *hidden, 1])}
+
+    return jax.vmap(one)(jax.random.split(rng, n_agents))
+
+
+def make_maddpg_update(pi_opt, q_opt, gamma: float, tau: float,
+                       bound: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def actions_of(pi_stacked, obs_nb):  # obs_nb: [N, B, d_o]
+        return jax.vmap(lambda p, o: bound * jnp.tanh(mlp_apply(p, o)))(
+            pi_stacked, obs_nb)  # -> [N, B, d_a]
+
+    def q_of(q_stacked, joint_b):  # joint_b: [B, joint] shared input
+        return jax.vmap(
+            lambda p: mlp_apply(p, joint_b)[..., 0])(q_stacked)  # [N, B]
+
+    def critic_loss(params, target_params, batch):
+        obs, act, rew, nxt, done = batch  # [B,N,do],[B,N,da],[B,N],...,[B]
+        B = obs.shape[0]
+        nxt_nb = jnp.swapaxes(nxt, 0, 1)                  # [N, B, d_o]
+        tgt_act = actions_of(target_params["pi"], nxt_nb)
+        tgt_joint = jnp.concatenate(
+            [nxt.reshape(B, -1),
+             jnp.swapaxes(tgt_act, 0, 1).reshape(B, -1)], -1)
+        tq = q_of(target_params["q"], tgt_joint)          # [N, B]
+        target = jnp.swapaxes(rew, 0, 1) + gamma * (1.0 - done)[None, :] \
+            * jax.lax.stop_gradient(tq)
+        joint = jnp.concatenate(
+            [obs.reshape(B, -1), act.reshape(B, -1)], -1)
+        q = q_of(params["q"], joint)                      # [N, B]
+        return jnp.mean((q - target) ** 2), q.mean()
+
+    def actor_loss(pi_stacked, params, batch):
+        obs, act, _, _, _ = batch
+        B, N, d_a = act.shape
+        obs_nb = jnp.swapaxes(obs, 0, 1)                  # [N, B, d_o]
+        my_act = actions_of(pi_stacked, obs_nb)           # [N, B, d_a]
+        # agent i's joint action: batch actions with COLUMN i replaced by
+        # mu_i(o_i) — one-hot masking keeps it a single vmapped program
+        eye = jnp.eye(N)[:, None, :, None]                # [N, 1, N, 1]
+        batch_a = act[None]                               # [1, B, N, d_a]
+        mine = jnp.swapaxes(my_act, 0, 1)[None]           # [1, B, N, d_a]
+
+        def joint_for(i_onehot):
+            return batch_a * (1.0 - i_onehot) + mine * i_onehot
+
+        joints = jax.vmap(joint_for)(eye)                 # [N,1,B,N,d_a]
+        joints = joints[:, 0].reshape(N, B, N * d_a)
+        full = jnp.concatenate(
+            [jnp.broadcast_to(obs.reshape(B, -1)[None],
+                              (N, B, obs.shape[1] * obs.shape[2])),
+             joints], -1)                                 # [N, B, joint]
+        q = jax.vmap(lambda p, x: mlp_apply(p, x)[..., 0])(
+            params["q"], full)                            # [N, B]
+        return -jnp.mean(q)
+
+    @jax.jit
+    def update(params, target_params, opt_states, batch):
+        pi_state, q_state = opt_states
+        # critic step: grads flow only through the critics (next actions
+        # come from target params), so updating the "q" subtree alone is
+        # exact — and keeps each optimizer's moments scoped to its net
+        (c_loss, mean_q), c_grads = jax.value_and_grad(
+            critic_loss, has_aux=True)(params, target_params, batch)
+        q_upd, q_state = q_opt.update(c_grads["q"], q_state, params["q"])
+        params = {**params,
+                  "q": optax.apply_updates(params["q"], q_upd)}
+
+        a_loss, pi_grads = jax.value_and_grad(actor_loss)(
+            params["pi"], params, batch)
+        pi_upd, pi_state = pi_opt.update(pi_grads, pi_state, params["pi"])
+        params = {**params,
+                  "pi": optax.apply_updates(params["pi"], pi_upd)}
+
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1.0 - tau) * t + tau * p, target_params, params)
+        stats = {"critic_loss": c_loss, "actor_loss": a_loss,
+                 "mean_q": mean_q}
+        return params, target_params, (pi_state, q_state), stats
+
+    return update
+
+
+class MADDPG(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import optax
+
+        from .env import make_env
+
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.env = make_env(config["env_spec"], config.get("env_config"))
+        if not isinstance(self.env, MultiAgentEnv):
+            raise ValueError("MADDPG trains multi-agent envs; use "
+                             "TD3/DDPG for single-agent control")
+        self.n_agents = len(self.env.agent_ids)
+        self.obs_dim = self.env.observation_dim
+        self.act_dim = int(getattr(self.env, "action_dim", 1))
+        self.bound = float(getattr(self.env, "action_bound", 1.0))
+        hidden = config.get("hidden", (64, 64))
+        self.params = maddpg_init(jax.random.key(seed), self.n_agents,
+                                  self.obs_dim, self.act_dim, hidden)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.pi_opt = optax.adam(config.get("lr", 1e-3))
+        self.q_opt = optax.adam(config.get("lr", 1e-3))
+        self.opt_states = (self.pi_opt.init(self.params["pi"]),
+                           self.q_opt.init(self.params["q"]))
+        self._update = make_maddpg_update(
+            self.pi_opt, self.q_opt, config.get("gamma", 0.95),
+            config.get("tau", 0.01), self.bound)
+        self.buffer = ReplayBuffer(config.get("buffer_size", 100_000))
+        self.batch_size = config.get("train_batch_size", 256)
+        self.sigma = config.get("exploration_sigma", 0.3)
+        self.random_steps = config.get("random_steps", 500)
+        self.updates_per_step = config.get("updates_per_iter", 20)
+        self.rollout_steps = config.get("rollout_fragment_length", 200)
+        self._rng = np.random.default_rng(seed)
+        self._obs = self.env.reset(seed=seed)
+        self._ep_reward = 0.0
+        self._ep_len = 0
+        self.episode_rewards: list = []
+        self._steps_sampled = 0
+        self._timesteps_total = 0  # algorithm.step's progress counter
+        self._updates_done = 0
+        self.workers = None        # local rollouts only (base contract)
+        self.local_worker = None
+
+    # ------------------------------------------------------------ rollouts
+    def _act(self, obs_dict, explore: bool = True) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        obs_nb = jnp.asarray(
+            np.stack([obs_dict[a] for a in self.env.agent_ids])[:, None])
+        import jax
+
+        acts = np.asarray(jax.vmap(
+            lambda p, o: self.bound * jnp.tanh(mlp_apply(p, o)))(
+                self.params["pi"], obs_nb))[:, 0]          # [N, d_a]
+        if explore:
+            if self._steps_sampled < self.random_steps:
+                acts = self._rng.uniform(
+                    -self.bound, self.bound, acts.shape).astype(np.float32)
+            else:
+                acts = np.clip(
+                    acts + self.sigma * self._rng.standard_normal(
+                        acts.shape).astype(np.float32),
+                    -self.bound, self.bound)
+        return {aid: acts[i] for i, aid in enumerate(self.env.agent_ids)}
+
+    def _rollout(self, num_steps: int) -> None:
+        ids = self.env.agent_ids
+        cols = {"obs": [], "act": [], "rew": [], "next_obs": [], "done": []}
+        for _ in range(num_steps):
+            acts = self._act(self._obs)
+            nxt, rew, terms, truncs, _ = self.env.step(acts)
+            done = bool(terms.get("__all__"))
+            trunc = bool(truncs.get("__all__"))
+            cols["obs"].append(np.stack([self._obs[a] for a in ids]))
+            cols["act"].append(np.stack(
+                [np.asarray(acts[a], np.float32) for a in ids]))
+            cols["rew"].append(
+                np.asarray([rew[a] for a in ids], np.float32))
+            cols["next_obs"].append(np.stack([nxt[a] for a in ids]))
+            # truncation bootstraps (done=0), true terminals don't —
+            # the same rule the single-agent collectors apply
+            cols["done"].append(
+                np.float32(1.0 if done and not trunc else 0.0))
+            self._ep_reward += float(np.mean([rew[a] for a in ids]))
+            self._ep_len += 1
+            self._steps_sampled += 1
+            self._timesteps_total += 1
+            if done or trunc:
+                self.episode_rewards.append(self._ep_reward)
+                self._obs = self.env.reset(
+                    seed=int(self._rng.integers(1 << 31)))
+                self._ep_reward, self._ep_len = 0.0, 0
+            else:
+                self._obs = nxt
+        self.buffer.add_batch({k: np.stack(v) for k, v in cols.items()})
+
+    # ------------------------------------------------------------ training
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        self._rollout(self.rollout_steps)
+        stats = {}
+        if len(self.buffer) >= self.batch_size:
+            for _ in range(self.updates_per_step):
+                cols = self.buffer.sample(self.batch_size)
+                batch = (
+                    jnp.asarray(cols["obs"]), jnp.asarray(cols["act"]),
+                    jnp.asarray(cols["rew"]),
+                    jnp.asarray(cols["next_obs"]),
+                    jnp.asarray(cols["done"]),
+                )
+                (self.params, self.target_params, self.opt_states,
+                 stats) = self._update(self.params, self.target_params,
+                                       self.opt_states, batch)
+                self._updates_done += 1
+        recent = self.episode_rewards[-20:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "episodes_total": len(self.episode_rewards),
+            "timesteps_total": self._steps_sampled,
+            "num_updates": self._updates_done,
+            **{k: float(v) for k, v in stats.items()},
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def _episode_metrics(self) -> Dict[str, Any]:
+        recent = self.episode_rewards[-100:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else None,
+            "episode_len_mean": None,
+            "episodes_total": len(self.episode_rewards),
+        }
+
+    def compute_actions(self, obs_dict) -> Dict[str, np.ndarray]:
+        """Decentralized execution: actors only, no critic."""
+        return self._act(obs_dict, explore=False)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def _sync_weights(self) -> None:
+        pass  # local rollouts
+
+    def _save_extra_state(self):
+        import jax
+
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "target": jax.tree_util.tree_map(np.asarray,
+                                                 self.target_params),
+                "steps": self._steps_sampled,
+                "updates": self._updates_done}
+
+    def _load_extra_state(self, state) -> None:
+        import jax.numpy as jnp
+
+        if not state:
+            return
+        import jax
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            jnp.asarray, state["target"])
+        self.opt_states = (self.pi_opt.init(self.params["pi"]),
+                           self.q_opt.init(self.params["q"]))
+        self._steps_sampled = state.get("steps", 0)
+        self._updates_done = state.get("updates", 0)
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(MADDPG)
+        self.extra.update({
+            "tau": 0.01, "exploration_sigma": 0.3, "random_steps": 500,
+            "updates_per_iter": 20, "buffer_size": 100_000,
+            "rollout_fragment_length": 200,
+        })
+
+    def training(self, *, tau=None, exploration_sigma=None,
+                 random_steps=None, updates_per_iter=None,
+                 buffer_size=None, **kwargs) -> "MADDPGConfig":
+        super().training(**kwargs)
+        for k, v in (("tau", tau),
+                     ("exploration_sigma", exploration_sigma),
+                     ("random_steps", random_steps),
+                     ("updates_per_iter", updates_per_iter),
+                     ("buffer_size", buffer_size)):
+            if v is not None:
+                self.extra[k] = v
+        return self

@@ -928,6 +928,107 @@ class TestAPPO:
         algo.stop()
 
 
+class TestMADDPG:
+    def test_learns_cooperative_rendezvous(self):
+        """Centralized critics + decentralized actors improve the
+        cooperative rendezvous reward (maddpg.py; the reference's
+        rllib/algorithms/maddpg two-agent MPE contract, CI-scaled).
+        Agents start ~1 apart (reward ~ -50/episode under random play)
+        and must learn to close the distance."""
+        from ray_memory_management_tpu.rllib import MADDPGConfig
+
+        algo = (MADDPGConfig()
+                .environment("Rendezvous",
+                             env_config={"n_agents": 2,
+                                         "max_episode_steps": 25})
+                .training(lr=1e-3, gamma=0.95, train_batch_size=128,
+                          random_steps=300, updates_per_iter=25)
+                .debugging(seed=7)
+                .build())
+        first, best = None, -np.inf
+        for _ in range(40):
+            result = algo.train()
+            r = result["episode_reward_mean"]
+            if not np.isnan(r):
+                if first is None:
+                    first = r
+                best = max(best, r)
+            if first is not None and best > first + 3.0:
+                break
+        assert first is not None and best > first + 3.0, (first, best)
+
+        # decentralized execution: actions come from the actors alone
+        env = algo.env
+        obs = env.reset(seed=123)
+        acts = algo.compute_actions(obs)
+        assert set(acts) == set(env.agent_ids)
+        for a in acts.values():
+            assert a.shape == (2,) and np.all(np.abs(a) <= 1.0)
+
+        # save/restore round-trips the stacked params
+        blob = algo.save()
+        import jax
+
+        before = jax.tree_util.tree_map(np.asarray, algo.params)
+        algo.stop()
+        from ray_memory_management_tpu.rllib import MADDPGConfig as C2
+
+        algo2 = (C2()
+                 .environment("Rendezvous",
+                              env_config={"n_agents": 2,
+                                          "max_episode_steps": 25})
+                 .debugging(seed=7)
+                 .build())
+        algo2.restore(blob)
+        after = jax.tree_util.tree_map(np.asarray, algo2.params)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_allclose(a, b)
+        algo2.stop()
+
+    def test_actor_grad_isolated_to_own_agent(self):
+        """The MADDPG gradient: agent i's actor loss must produce ZERO
+        gradient on agent j's actor (others' actions come from the
+        batch, not their policies)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_memory_management_tpu.rllib.maddpg import maddpg_init
+
+        n, do, da, B = 3, 6, 2, 4
+        params = maddpg_init(jax.random.key(0), n, do, da, hidden=(8,))
+        rng = np.random.default_rng(0)
+        batch = (jnp.asarray(rng.standard_normal((B, n, do)),
+                             jnp.float32),
+                 jnp.asarray(rng.standard_normal((B, n, da)),
+                             jnp.float32),
+                 jnp.asarray(rng.standard_normal((B, n)), jnp.float32),
+                 jnp.asarray(rng.standard_normal((B, n, do)),
+                             jnp.float32),
+                 jnp.zeros((B,), jnp.float32))
+
+        # recreate the actor loss with a PER-AGENT mean to probe agent 0
+        from ray_memory_management_tpu.rllib.maddpg import mlp_apply
+
+        def actor_loss_agent0(pi_stacked):
+            obs, act = batch[0], batch[1]
+            obs_nb = jnp.swapaxes(obs, 0, 1)
+            my = jax.vmap(lambda p, o: jnp.tanh(mlp_apply(p, o)))(
+                pi_stacked, obs_nb)
+            joint = act.at[:, 0].set(jnp.swapaxes(my, 0, 1)[:, 0])
+            x = jnp.concatenate([obs.reshape(B, -1),
+                                 joint.reshape(B, -1)], -1)
+            q0 = mlp_apply(jax.tree_util.tree_map(lambda l: l[0],
+                                                  params["q"]), x)
+            return -jnp.mean(q0)
+
+        grads = jax.grad(actor_loss_agent0)(params["pi"])
+        leaves = jax.tree_util.tree_leaves(grads)
+        for leaf in leaves:
+            assert float(jnp.abs(leaf[0]).sum()) > 0  # own grad flows
+            assert float(jnp.abs(leaf[1:]).sum()) == 0  # others' are zero
+
+
 class TestES:
     def test_learns_cartpole_gradient_free(self):
         """Evolution strategies improves CartPole with no gradients
@@ -967,6 +1068,64 @@ class TestES:
         algo2.restore(blob)
         np.testing.assert_allclose(algo2.theta, theta)
         algo2.stop()
+
+    def test_ars_learns_cartpole_with_linear_policy(self):
+        """ARS improves CartPole with a LINEAR policy — top-k direction
+        selection, return-std step scaling, and the running observation
+        filter (ars.py; the reference's rllib/algorithms/ars contract,
+        CI-scaled)."""
+        from ray_memory_management_tpu.rllib import ARSConfig
+
+        algo = (ARSConfig()
+                .environment("CartPole",
+                             env_config={"max_episode_steps": 200})
+                .rollouts(num_rollout_workers=0)
+                .training(lr=0.3, sigma=0.5, num_directions=32,
+                          top_directions=16)
+                .debugging(seed=5)
+                .build())
+        best = 0.0
+        result = {}
+        for _ in range(25):
+            result = algo.train()
+            best = max(best, result["fitness_mean"])
+            if best > 120:
+                break
+        assert best > 60, (best, result)
+        assert result["filter_count"] > 0  # the obs filter accumulated
+        a = algo.compute_single_action(
+            np.array([0.01, 0.0, 0.02, 0.0], np.float32))
+        assert a in (0, 1)
+        # save/restore round-trips theta AND the observation filter
+        blob = algo.save()
+        theta = algo.theta.copy()
+        count = algo.filter.count
+        algo.stop()
+        from ray_memory_management_tpu.rllib import ARSConfig as C2
+
+        algo2 = (C2()
+                 .environment("CartPole",
+                              env_config={"max_episode_steps": 200})
+                 .rollouts(num_rollout_workers=0)
+                 .debugging(seed=5)
+                 .build())
+        algo2.restore(blob)
+        np.testing.assert_allclose(algo2.theta, theta)
+        assert algo2.filter.count == count
+        algo2.stop()
+
+    def test_ars_filter_delta_merge(self):
+        """Worker filter increments fold into the master filter exactly
+        (the MeanStdFilter delta-sync invariant)."""
+        from ray_memory_management_tpu.rllib.ars import _ObsFilter
+
+        master = _ObsFilter(3)
+        obs = np.arange(12, dtype=np.float64).reshape(4, 3)
+        master.merge({"count": 4.0, "sum": obs.sum(0),
+                      "sumsq": (obs * obs).sum(0)})
+        snap = master.snapshot()
+        np.testing.assert_allclose(snap["mean"], obs.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(snap["std"], obs.std(0), rtol=1e-5)
 
     def test_seed_reconstruction_matches_worker(self):
         """The learner's jit-reconstructed perturbation equals the

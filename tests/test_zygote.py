@@ -125,6 +125,30 @@ def test_forked_proc_liveness_and_kill():
     assert proc2.poll() is not None
 
 
+def test_forked_worker_env_fidelity():
+    """A forked worker's environment must be EXACTLY what
+    build_worker_env produced — the delta protocol resets the child to
+    the client's baseline, not the zygote's own (drifted) environ. The
+    regression this pins: sitecustomize sets JAX_PLATFORMS in the zygote
+    at interpreter startup, and a child reset to the zygote's environ
+    ran jax on the wrong platform (every rllib remote worker failed)."""
+    rmt.init(num_cpus=2)
+    try:
+        @rmt.remote
+        def probe_env():
+            return (os.environ.get("JAX_PLATFORMS"),
+                    os.environ.get("RMT_ZYGOTE_AUTHKEY"),
+                    os.environ.get("RMT_WORKER_ID") is not None)
+
+        jax_platforms, authkey, has_wid = rmt.get(probe_env.remote(),
+                                                  timeout=120)
+        assert jax_platforms == "cpu"   # NOT the zygote's drifted value
+        assert authkey is None          # the zygote secret never leaks
+        assert has_wid                  # per-worker delta vars applied
+    finally:
+        rmt.shutdown()
+
+
 def test_preload_taint_retires_zygote():
     """A class blob whose unpickling initializes a jax backend must not be
     preloaded pre-fork (every later child would inherit a fork-broken
