@@ -352,9 +352,10 @@ class ZygoteClient:
         # them via COW): spawns of these ship WITHOUT the cls_blob
         self._cached_classes: set = set()
         # phase accounting for the scale bench (fork share of actor
-        # creation): total forks requested and seconds spent in batch
-        # round trips (seconds/forks = amortized per-fork round trip)
+        # creation): total forks requested, batch round trips made, and
+        # seconds spent in them (seconds/forks = amortized per-fork RT)
         self.spawn_count = 0
+        self.spawn_batches = 0
         self.spawn_seconds = 0.0
 
     def _connect(self, timeout: float = 10.0):
@@ -480,6 +481,7 @@ class ZygoteClient:
             return
         self.spawn_seconds += time.monotonic() - t0
         self.spawn_count += len(batch)
+        self.spawn_batches += 1
         for i, e in enumerate(batch):
             e.reply = replies[i] if i < len(replies) else None
             e.done.set()
