@@ -4,10 +4,12 @@
 // analog is a C++ worker executing RAY_REMOTE functions
 // (cpp/src/ray/runtime/task/task_executor.cc).
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <stdexcept>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "rmt_client.hpp"
@@ -32,6 +34,11 @@ int main(int argc, char** argv) {
                 [](const std::vector<std::string>&) -> std::vector<std::string> {
                   throw std::runtime_error("kaboom");
                 });
+    ex.Register("sleep_ms", [](const std::vector<std::string>& args) {
+      long ms = args.empty() ? 0 : std::strtol(args[0].c_str(), nullptr, 10);
+      std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+      return std::vector<std::string>{std::string("slept")};
+    });
     ex.Start();
     std::printf("EXECUTOR READY\n");
     std::fflush(stdout);

@@ -253,6 +253,12 @@ int64_t store_create(const char* name, uint64_t capacity) {
   pthread_mutex_init(&h->mutex, &attr);
   __sync_synchronize();
   h->magic = kMagic;
+  // NOTE on prefaulting: deliberately NOT done here. Populating the
+  // arena (MADV_POPULATE_WRITE) kills first-touch fault costs on bulk
+  // writes, but makes the FILE fully resident — and 2,000 spawned
+  // workers mapping a fully-resident multi-GB shm file measured 3x
+  // slower to boot than against a sparse one. The Python client
+  // (shm_client.ShmStore) owns that tradeoff with a size/memory gate.
   return register_store(std::move(st));
 }
 

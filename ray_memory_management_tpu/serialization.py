@@ -188,7 +188,34 @@ class SerializedObject:
         return bytes(out)
 
 
+# Memoized pickle streams for plain bulk ndarrays: with protocol-5
+# out-of-band buffers the stream is a pure function of (shape, dtype,
+# layout, writeability) — buffer references are POSITIONAL — so the
+# pickler run can be skipped entirely on the bulk-put hot path (it was
+# ~4% of a 16 MB put, all of the non-memcpy overhead that remained).
+_ARRAY_STREAM_CACHE: dict = {}
+_ARRAY_CACHE_MIN_BYTES = 1 << 20
+
+
+def _plain_array_key(value):
+    import numpy as np
+
+    if (type(value) is np.ndarray
+            and value.nbytes >= _ARRAY_CACHE_MIN_BYTES
+            and value.dtype != object
+            and (value.flags.c_contiguous or value.flags.f_contiguous)):
+        return (value.shape, value.dtype.str, value.flags.c_contiguous,
+                value.flags.writeable)
+    return None
+
+
 def serialize(value: Any) -> SerializedObject:
+    key = _plain_array_key(value)
+    if key is not None:
+        hit = _ARRAY_STREAM_CACHE.get(key)
+        if hit is not None:
+            # the same raw view the pickler's buffer_callback would yield
+            return _assemble(hit, [pickle.PickleBuffer(value).raw()])
     stream = io.BytesIO()
     raw_buffers: List[pickle.PickleBuffer] = []
     pickler = _JaxAwarePickler(
@@ -196,18 +223,19 @@ def serialize(value: Any) -> SerializedObject:
     )
     pickler.dump(value)
     pickled = stream.getvalue()
+    if key is not None and len(raw_buffers) == 1:
+        if len(_ARRAY_STREAM_CACHE) >= 256:  # bound shape-churn growth
+            _ARRAY_STREAM_CACHE.clear()
+        _ARRAY_STREAM_CACHE[key] = pickled
 
     if not raw_buffers:
         return SerializedObject(_MAGIC_SMALL, pickled, [],
                                 len(_MAGIC_SMALL) + len(pickled))
+    return _assemble(pickled, [pb.raw() for pb in raw_buffers])
 
-    views: List[memoryview] = []
-    sizes: List[int] = []
-    for pb in raw_buffers:
-        mv = pb.raw()
-        views.append(mv)
-        sizes.append(mv.nbytes)
 
+def _assemble(pickled: bytes, views: List[memoryview]) -> SerializedObject:
+    sizes = [mv.nbytes for mv in views]
     # Header: MAGIC | u64 meta_len | msgpack{pickle_off, pickle_len, buf_sizes, total}
     # Two-pass: meta length depends on total, which depends on meta length; the
     # meta is small so iterate to fixed point (at most twice).

@@ -84,6 +84,30 @@ class TestCppWorker:
                 server.close()
             rmt.shutdown()
 
+    def test_freed_promise_drops_late_resolution(self):
+        """A promise freed before resolution (its caller disconnected or
+        dropped the ref) must purge its pending future and DROP a late
+        result instead of storing an ownerless object forever."""
+        rmt.init(num_cpus=1)
+        try:
+            from ray_memory_management_tpu import _worker_context
+
+            rt = _worker_context.get_runtime()
+            oid = rt.create_promise()
+            assert oid in rt.futures and oid in rt._promises
+            rt.free_objects([oid])
+            assert oid not in rt.futures and oid not in rt._promises
+            rt.resolve_promise(oid, value=b"late")  # must be dropped
+            assert oid not in rt.memory_store
+            assert oid not in rt.futures
+
+            # and a live promise resolves normally
+            oid2 = rt.create_promise()
+            rt.resolve_promise(oid2, value=b"ontime")
+            assert rt.get_objects([oid2], timeout=10) == [b"ontime"]
+        finally:
+            rmt.shutdown()
+
     def test_executor_death_fails_tasks_and_deregisters(
             self, executor_binary):
         """Killing the executor fails its undelivered tasks loudly and
@@ -100,10 +124,11 @@ class TestCppWorker:
                                     stdout=subprocess.PIPE,
                                     stderr=subprocess.PIPE, text=True)
             _wait_registered("add_i64")
-            # park a task the executor will never finish: kill it right
-            # after it picks the task up (or before — either way the
-            # promise must fail, not hang)
-            ref = rmt.cpp_function("add_i64").remote(b"1")
+            # park a task the executor CANNOT finish before the kill (a
+            # fast add could complete first and no error would surface):
+            # it sleeps executor-side; kill lands mid-task — or before
+            # pickup — and either way the promise must fail, not hang
+            ref = rmt.cpp_function("sleep_ms").remote(b"30000")
             proc.kill()
             proc.wait(timeout=10)
             with pytest.raises(TaskError, match="disconnected"):

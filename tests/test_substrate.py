@@ -81,6 +81,33 @@ def test_roundtrip_numpy_zero_copy():
     assert out["a"].base is not None  # zero-copy view
 
 
+def test_bulk_array_stream_cache_is_exact():
+    """The memoized pickle stream for plain bulk ndarrays (the bulk-put
+    hot path) must byte-match a fresh pickler run for every cached
+    layout — C/F order, writeable/readonly — and round-trip with the
+    values intact."""
+    ser._ARRAY_STREAM_CACHE.clear()
+    variants = []
+    base = np.arange(ser._ARRAY_CACHE_MIN_BYTES // 8 * 2,
+                     dtype=np.float64).reshape(2, -1)
+    variants.append(base.copy())                       # C contiguous
+    variants.append(np.asfortranarray(base.copy()))    # F contiguous
+    ro = base.copy()
+    ro.setflags(write=False)
+    variants.append(ro)                                # readonly
+    for arr in variants:
+        first = ser.serialize(arr)          # miss: populates the cache
+        cached = ser.serialize(arr)         # hit: memoized stream
+        assert cached._pickled == first._pickled
+        assert cached.total_size == first.total_size
+        out = ser.loads(memoryview(cached.to_bytes()))
+        np.testing.assert_array_equal(out, arr)
+        # different VALUES, same layout: the hit must carry the new data
+        arr2 = arr * 0 + 7.0 if arr.flags.writeable else base + 7.0
+        out2 = ser.loads(memoryview(ser.serialize(arr2).to_bytes()))
+        np.testing.assert_array_equal(out2, arr2)
+
+
 def test_on_release_fires_when_views_die():
     released = []
     arr = np.ones(1000)
