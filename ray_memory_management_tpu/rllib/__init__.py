@@ -15,6 +15,7 @@ from .connectors import (  # noqa: F401
     ObsNormalizer,
     register_connector,
 )
+from .alphazero import AlphaZero, AlphaZeroConfig, TicTacToe  # noqa: F401
 from .appo import APPO, APPOConfig  # noqa: F401
 from .ars import ARS, ARSConfig  # noqa: F401
 from .bandit import (  # noqa: F401

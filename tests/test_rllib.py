@@ -928,6 +928,79 @@ class TestAPPO:
         algo.stop()
 
 
+class TestAlphaZero:
+    def test_mcts_finds_forced_win_without_learning(self):
+        """PUCT search alone (uniform priors, zero values) must
+        concentrate visits on the winning move of a tactics position —
+        the search half of AlphaZero, isolated from the network
+        (alphazero.py BatchedMCTS; reference mcts.py)."""
+        from ray_memory_management_tpu.rllib.alphazero import (
+            BatchedMCTS, TicTacToe)
+
+        def uniform_eval(obs):
+            B = obs.shape[0]
+            return (np.full((B, 9), 1.0 / 9, np.float64), np.zeros(B))
+
+        g = TicTacToe()
+        for mv in (0, 3, 1, 4):  # X holds 0,1: the winning move is 2
+            g.step(mv)
+        mcts = BatchedMCTS(uniform_eval, n_sims=200,
+                           rng=np.random.default_rng(0))
+        pi = mcts.search_batch([g], add_noise=False)[0]
+        assert int(pi.argmax()) == 2
+        assert pi[2] > 0.6  # visits concentrate, not a lucky argmax
+
+    def test_self_play_learns_tictactoe(self):
+        """MCTS-guided self-play + the AlphaZero loss beats a random
+        opponent decisively after a short run (the reference's
+        alpha_zero learning contract, CI-scaled: measured 58W/0L/2D in
+        60 games at these settings; thresholds leave slack)."""
+        from ray_memory_management_tpu.rllib import (
+            AlphaZeroConfig, TicTacToe)
+
+        algo = (AlphaZeroConfig()
+                .training(lr=3e-3, num_simulations=32, games_per_iter=32,
+                          num_sgd_iter=10)
+                .debugging(seed=1)
+                .build())
+        first_loss = None
+        for _ in range(10):
+            r = algo.train()
+            if first_loss is None:
+                first_loss = r["policy_loss"]
+        assert r["policy_loss"] < first_loss  # the policy head converges
+
+        rng = np.random.default_rng(42)
+        wins = losses = 0
+        for _ in range(60):
+            g = TicTacToe()
+            while g.outcome() is None:
+                if g.player == 1:
+                    a = algo.compute_single_action(g, greedy_sims=24)
+                else:
+                    a = int(rng.choice(np.flatnonzero(g.legal())))
+                g.step(a)
+            out = g.outcome()
+            wins += out == 1
+            losses += out == -1
+        assert wins >= 45 and losses <= 6, (wins, losses)
+
+        # save/restore round-trips the two heads
+        blob = algo.save()
+        import jax
+
+        before = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, algo.params))
+        algo.stop()
+        algo2 = AlphaZeroConfig().debugging(seed=1).build()
+        algo2.restore(blob)
+        after = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, algo2.params))
+        for a, b in zip(before, after):
+            np.testing.assert_allclose(a, b)
+        algo2.stop()
+
+
 class TestMADDPG:
     def test_learns_cooperative_rendezvous(self):
         """Centralized critics + decentralized actors improve the
