@@ -28,6 +28,7 @@ from .bandit import (  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
+from .mbrl import MBPETS, MBPETSConfig  # noqa: F401
 from .multi_agent import (  # noqa: F401
     MultiAgentEnv,
     MultiAgentRolloutWorker,
