@@ -63,5 +63,6 @@ from .recurrent import (  # noqa: F401
 )
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
+from .slateq import InterestEvolution, SlateQ, SlateQConfig  # noqa: F401
 from .td3 import TD3, DDPGConfig, TD3Config  # noqa: F401
 from .rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

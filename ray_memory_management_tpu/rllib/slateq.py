@@ -1,0 +1,407 @@
+"""SlateQ: slate recommendation Q-learning with choice-model decomposition.
+
+The reference's rllib/algorithms/slateq/ (Ie et al. 2019, paired with
+RecSim's interest-evolution environment): the combinatorial action — a
+SLATE of k documents out of N candidates — decomposes under a
+single-choice user model into per-item values,
+
+    Q(s, slate) = sum_{i in slate} P(click i | s, slate) * Qbar(s, i),
+
+so only the ITEM-wise Qbar(s, d) must be learned (a |slate|-free
+network), the TD backup weights next-slate item values by the choice
+model's click probabilities, and slate construction is the standard
+top-k-by-score greedy over v(s,d) * Qbar(s,d).
+
+TPU-first shape: every per-item evaluation batches — the update runs
+Qbar over [B, N] candidate features in one forward (vmap-free: the MLP
+just sees a [B*N, feat] matmul), the choice-model weighting and the
+decomposed backup are pure tensor algebra inside ONE jit, and acting
+scores all candidates in one call. A compact interest-evolution env
+(user interests drift toward clicked topics, engagement is the reward)
+stands in for RecSim, with myopic-vs-long-term structure: clickbait
+docs get clicks but erode the session, quality docs compound it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import register_env
+from .models import mlp_apply, mlp_init
+from .replay import ReplayBuffer
+
+
+class InterestEvolution:
+    """Slate recommendation env (RecSim interest_evolution, reduced).
+
+    - ``n_docs`` documents, each a unit topic vector + a quality scalar;
+      low-quality docs are CLICKBAIT: higher click appeal, but clicking
+      them drains the session budget with little engagement. High-quality
+      docs engage long term (the myopic-vs-SlateQ tension the paper's
+      experiments measure).
+    - The user holds an interest vector; a click drifts it toward the
+      clicked doc's topic.
+    - Choice model: conditional logit over the slate + a no-click option
+      (exp scores; exposed via :meth:`choice_scores` — SlateQ assumes
+      the choice model is known/estimated, as the reference does).
+    - obs = [user interests, all doc features flat] (fully observed doc
+      corpus; the policy's job is slate COMPOSITION).
+    """
+
+    def __init__(self, n_docs: int = 20, n_topics: int = 6,
+                 slate_size: int = 3, max_episode_steps: int = 20,
+                 seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.n_docs = n_docs
+        self.n_topics = n_topics
+        self.slate_size = slate_size
+        self.max_episode_steps = max_episode_steps
+        topics = rng.standard_normal((n_docs, n_topics))
+        self.doc_topics = (topics / np.linalg.norm(
+            topics, axis=1, keepdims=True)).astype(np.float32)
+        # quality in [0, 1]; appeal is anti-correlated (clickbait)
+        self.doc_quality = rng.uniform(0, 1, n_docs).astype(np.float32)
+        self.doc_appeal = (1.2 - self.doc_quality
+                           + 0.2 * rng.standard_normal(n_docs)
+                           ).astype(np.float32)
+        self.doc_feats = np.concatenate(
+            [self.doc_topics, self.doc_quality[:, None],
+             self.doc_appeal[:, None]], axis=1)  # [N, n_topics+2]
+        self.feat_dim = self.doc_feats.shape[1]
+        self.observation_dim = n_topics + n_docs * self.feat_dim
+        self._rng = rng
+        self._interest = np.zeros(n_topics, np.float32)
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.concatenate(
+            [self._interest, self.doc_feats.ravel()]).astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        v = self._rng.standard_normal(self.n_topics)
+        self._interest = (v / np.linalg.norm(v)).astype(np.float32)
+        self._t = 0
+        return self._obs()
+
+    def choice_scores(self, docs: np.ndarray) -> np.ndarray:
+        """exp conditional-logit scores v(s, d) for given doc indices;
+        the no-click option scores exp(0) = 1."""
+        affinity = self.doc_topics[docs] @ self._interest
+        return np.exp(affinity + self.doc_appeal[docs])
+
+    def step(self, slate: List[int]):
+        """slate: doc indices. Returns (obs, reward, term, trunc, info);
+        info carries which doc was clicked (or -1)."""
+        slate = list(slate)
+        self._t += 1
+        scores = self.choice_scores(np.asarray(slate))
+        total = scores.sum() + 1.0  # + the no-click option
+        probs = np.concatenate([scores / total, [1.0 / total]])
+        pick = int(self._rng.choice(len(slate) + 1, p=probs))
+        reward = 0.0
+        clicked = -1
+        if pick < len(slate):
+            clicked = slate[pick]
+            q = float(self.doc_quality[clicked])
+            reward = q  # engagement tracks quality, not appeal
+            # interests drift toward the clicked topic
+            self._interest = (0.9 * self._interest
+                              + 0.1 * self.doc_topics[clicked])
+            self._interest /= max(np.linalg.norm(self._interest), 1e-6)
+        trunc = self._t >= self.max_episode_steps
+        return self._obs(), reward, False, trunc, {"clicked": clicked}
+
+
+register_env("InterestEvolution", InterestEvolution)
+
+
+def _slate_combos(pruned: int, k: int) -> np.ndarray:
+    """All C(pruned, k) index combinations, as a static array — exact
+    slate optimization over a pruned candidate set enumerates inside
+    jit with fixed shapes (the paper optimizes slates exactly via an
+    LP; over <=8 pruned candidates brute force is cheaper than either
+    the LP or the top-k greedy's regret)."""
+    from itertools import combinations
+
+    return np.asarray(list(combinations(range(pruned), k)), np.int32)
+
+
+def _best_slate_value(scores, q, combos, prune):
+    """max over slates of sum(s_i q_i) / (sum s_i + 1): the decomposed
+    slate value under the conditional-logit choice model (+1 = the
+    no-click option). scores/q: [..., N]; returns (value, best combo
+    rows of the pruned top)."""
+    import jax
+    import jax.numpy as jnp
+
+    top_s, top_idx = jax.lax.top_k(scores * jnp.maximum(q, 0.0), prune)
+    s_p = jnp.take_along_axis(scores, top_idx, axis=-1)   # [..., prune]
+    q_p = jnp.take_along_axis(q, top_idx, axis=-1)
+    s_c = s_p[..., combos]                                # [..., C, k]
+    q_c = q_p[..., combos]
+    v = (s_c * q_c).sum(-1) / (s_c.sum(-1) + 1.0)         # [..., C]
+    best = v.argmax(-1)
+    return jnp.take_along_axis(v, best[..., None], -1)[..., 0], \
+        top_idx, best
+
+
+def make_slateq_update(opt, gamma: float):
+    """The decomposed TD step, one jit: Qbar over all [B, N] candidates,
+    exact pruned-combinatorial next-slate optimization, and the
+    choice-probability-weighted backup (slateq.py's decomposed target;
+    slate optimization exact rather than top-k greedy — greedy ranks by
+    s*Q and seats clickbait rows whose high appeal STEALS probability
+    mass from higher-value items, precisely this env's failure mode)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def qbar_all(params, user, feats):
+        """[B, n_topics] user x [B, N, feat] docs -> [B, N] item values."""
+        B, N, F = feats.shape
+        u = jnp.repeat(user[:, None, :], N, axis=1)
+        x = jnp.concatenate([u, feats], -1).reshape(B * N, -1)
+        return mlp_apply(params, x)[..., 0].reshape(B, N)
+
+    def loss(params, target_params, batch, slate_size, combos, prune):
+        (user, feats, clicked_feat, rew, nxt_user, nxt_feats,
+         nxt_scores, done) = batch
+        # target: value of the BEST next slate (exact over pruned set)
+        nq = qbar_all(target_params, nxt_user, nxt_feats)      # [B, N]
+        v_next, _, _ = _best_slate_value(nxt_scores, nq, combos, prune)
+        target = rew + gamma * (1.0 - done) * \
+            jax.lax.stop_gradient(v_next)
+        # online: Qbar of the clicked item only (no-click transitions
+        # carry zero reward and train nothing item-wise — slateq.py
+        # likewise learns from click events)
+        x = jnp.concatenate([user, clicked_feat], -1)
+        q = mlp_apply(params, x)[..., 0]
+        return jnp.mean((q - target) ** 2), q.mean()
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(4, 6))
+    def update(params, target_params, opt_state, batch, slate_size,
+               combos, prune):
+        (l, mean_q), grads = jax.value_and_grad(loss, has_aux=True)(
+            params, target_params, batch, slate_size, combos, prune)
+        upd, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, upd)
+        return params, opt_state, {"td_loss": l, "mean_q": mean_q}
+
+    return update
+
+
+class SlateQ(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import optax
+
+        from .env import make_env
+
+        self.cfg = config
+        seed = config.get("seed", 0)
+        self.env = make_env(config["env_spec"], config.get("env_config"))
+        if not hasattr(self.env, "choice_scores"):
+            raise ValueError("SlateQ needs a slate env exposing the "
+                             "user choice model (choice_scores)")
+        self.n_docs = self.env.n_docs
+        self.slate_size = self.env.slate_size
+        self.feat_dim = self.env.feat_dim
+        self.n_topics = self.env.n_topics
+        hidden = config.get("hidden", (64, 64))
+        self.params = mlp_init(
+            jax.random.key(seed),
+            [self.n_topics + self.feat_dim, *hidden, 1])
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.opt = optax.adam(config.get("lr", 1e-3))
+        self.opt_state = self.opt.init(self.params)
+        self._update = make_slateq_update(self.opt,
+                                          config.get("gamma", 0.95))
+        self._prune = min(config.get("slate_prune", 8), self.n_docs)
+        self._combos = _slate_combos(self._prune, self.slate_size)
+        self.buffer = ReplayBuffer(config.get("buffer_size", 50_000))
+        self.batch_size = config.get("train_batch_size", 128)
+        self.updates_per_iter = config.get("updates_per_iter", 40)
+        self.rollout_steps = config.get("rollout_fragment_length", 200)
+        self.target_every = config.get("target_update_freq", 200)
+        self.eps = config.get("epsilon", 1.0)
+        self.eps_final = config.get("epsilon_final", 0.05)
+        self.eps_steps = config.get("epsilon_timesteps", 2000)
+        self._rng = np.random.default_rng(seed)
+        self._obs_user = None
+        self.env.reset(seed=seed)
+        self._ep_reward = 0.0
+        self.episode_rewards: List[float] = []
+        self._timesteps_total = 0
+        self._updates_done = 0
+        self.workers = None
+        self.local_worker = None
+
+    # -------------------------------------------------------------- acting
+    def _qbar(self, interest: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        feats = self.env.doc_feats                      # [N, F]
+        u = np.repeat(interest[None, :], self.n_docs, 0)
+        x = jnp.asarray(np.concatenate([u, feats], 1))
+        return np.asarray(mlp_apply(self.params, x)[..., 0])
+
+    def _slate(self, explore: bool) -> List[int]:
+        if explore and self._rng.random() < self._epsilon():
+            return list(self._rng.choice(self.n_docs, self.slate_size,
+                                         replace=False))
+        import jax.numpy as jnp
+
+        interest = self.env._interest
+        scores = self.env.choice_scores(np.arange(self.n_docs))
+        q = self._qbar(interest)
+        _, top_idx, best = _best_slate_value(
+            jnp.asarray(scores), jnp.asarray(q), self._combos,
+            self._prune)
+        rows = self._combos[int(best)]
+        return [int(top_idx[r]) for r in rows]
+
+    def _epsilon(self) -> float:
+        frac = min(1.0, self._timesteps_total / self.eps_steps)
+        return self.eps + frac * (self.eps_final - self.eps)
+
+    # ------------------------------------------------------------- training
+    def _collect(self, n: int) -> None:
+        env = self.env
+        cols = {k: [] for k in ("user", "clicked_feat", "rew", "nxt_user",
+                                "nxt_scores", "done")}
+        for _ in range(n):
+            user = env._interest.copy()
+            slate = self._slate(explore=True)
+            _, r, term, trunc, info = env.step(slate)
+            self._ep_reward += r
+            self._timesteps_total += 1
+            clicked = info["clicked"]
+            if clicked >= 0:  # item-wise learning happens on clicks
+                cols["user"].append(user)
+                cols["clicked_feat"].append(env.doc_feats[clicked])
+                cols["rew"].append(np.float32(r))
+                cols["nxt_user"].append(env._interest.copy())
+                cols["nxt_scores"].append(env.choice_scores(
+                    np.arange(self.n_docs)).astype(np.float32))
+                cols["done"].append(np.float32(1.0 if term else 0.0))
+            if term or trunc:
+                self.episode_rewards.append(self._ep_reward)
+                self._ep_reward = 0.0
+                env.reset(seed=int(self._rng.integers(1 << 31)))
+        if cols["user"]:
+            self.buffer.add_batch(
+                {k: np.stack(v) for k, v in cols.items()})
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        self._collect(self.rollout_steps)
+        stats = {}
+        feats_all = jnp.asarray(
+            np.repeat(self.env.doc_feats[None], self.batch_size, 0))
+        if len(self.buffer) >= self.batch_size:
+            for _ in range(self.updates_per_iter):
+                cols = self.buffer.sample(self.batch_size)
+                batch = (
+                    jnp.asarray(cols["user"]), feats_all,
+                    jnp.asarray(cols["clicked_feat"]),
+                    jnp.asarray(cols["rew"]),
+                    jnp.asarray(cols["nxt_user"]), feats_all,
+                    jnp.asarray(cols["nxt_scores"]),
+                    jnp.asarray(cols["done"]),
+                )
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    batch, self.slate_size, self._combos, self._prune)
+                self._updates_done += 1
+                if self._updates_done % self.target_every == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+        recent = self.episode_rewards[-20:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "epsilon": self._epsilon(),
+            "num_updates": self._updates_done,
+            **{k: float(v) for k, v in stats.items()},
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def _episode_metrics(self) -> Dict[str, Any]:
+        recent = self.episode_rewards[-50:]
+        return {
+            "episode_reward_mean": float(np.mean(recent)) if recent
+            else None,
+            "episode_len_mean": None,
+            "episodes_total": len(self.episode_rewards),
+        }
+
+    def compute_slate(self) -> List[int]:
+        """Greedy slate for the env's CURRENT user state."""
+        return self._slate(explore=False)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def _sync_weights(self) -> None:
+        pass  # local rollouts
+
+    def _save_extra_state(self):
+        import jax
+
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "updates": self._updates_done,
+                "steps": self._timesteps_total}
+
+    def _load_extra_state(self, state) -> None:
+        if not state:
+            return
+        import jax
+
+        self.set_weights(state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.opt_state = self.opt.init(self.params)
+        self._updates_done = state.get("updates", 0)
+        self._timesteps_total = state.get("steps", 0)
+
+
+class SlateQConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SlateQ)
+        self.env_spec = "InterestEvolution"
+        self.train_batch_size = 128
+        self.extra.update({
+            "updates_per_iter": 40, "target_update_freq": 200,
+            "epsilon": 1.0, "epsilon_final": 0.05,
+            "epsilon_timesteps": 2000, "buffer_size": 50_000,
+        })
+
+    def training(self, *, updates_per_iter=None, target_update_freq=None,
+                 epsilon_timesteps=None, **kwargs) -> "SlateQConfig":
+        super().training(**kwargs)
+        for k, v in (("updates_per_iter", updates_per_iter),
+                     ("target_update_freq", target_update_freq),
+                     ("epsilon_timesteps", epsilon_timesteps)):
+            if v is not None:
+                self.extra[k] = v
+        return self

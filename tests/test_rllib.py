@@ -928,6 +928,69 @@ class TestAPPO:
         algo.stop()
 
 
+class TestSlateQ:
+    def test_exact_slate_beats_myopic_and_random(self):
+        """SlateQ's choice-model decomposition + exact pruned slate
+        optimization must clearly beat both random slates (~8.9/ep) and
+        the myopic appeal-greedy (~6.4/ep) on the clickbait-structured
+        interest-evolution env (slateq.py; the reference's
+        rllib/algorithms/slateq contract — measured 13.0 at iter 14,
+        oracle ~16; thresholds leave slack)."""
+        from ray_memory_management_tpu.rllib import SlateQConfig
+
+        algo = (SlateQConfig()
+                .training(lr=1e-3, gamma=0.95, updates_per_iter=40)
+                .debugging(seed=7)
+                .build())
+        for _ in range(15):
+            r = algo.train()
+        assert r["episode_reward_mean"] > 10.5, r["episode_reward_mean"]
+
+        # the greedy slate is a valid slate over the real corpus
+        slate = algo.compute_slate()
+        assert len(slate) == algo.slate_size
+        assert len(set(slate)) == algo.slate_size
+        assert all(0 <= d < algo.n_docs for d in slate)
+
+        # save/restore round-trips the item-value network
+        blob = algo.save()
+        import jax
+
+        before = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, algo.params))
+        algo.stop()
+        from ray_memory_management_tpu.rllib import SlateQConfig as C2
+
+        algo2 = C2().debugging(seed=7).build()
+        algo2.restore(blob)
+        after = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, algo2.params))
+        for a, b in zip(before, after):
+            np.testing.assert_allclose(a, b)
+        algo2.stop()
+
+    def test_decomposed_slate_value_prefers_value_over_appeal(self):
+        """The exact slate optimizer must REFUSE a clickbait item that
+        steals probability mass: given one high-appeal/zero-value doc
+        and several modest-appeal/high-value docs, the chosen slate
+        excludes the clickbait row (top-k by s*Q greedy would seat it
+        — the regret mode the exact optimizer exists to avoid)."""
+        import jax.numpy as jnp
+
+        from ray_memory_management_tpu.rllib.slateq import (
+            _best_slate_value, _slate_combos)
+
+        scores = jnp.asarray([50.0, 2.0, 2.0, 2.0, 0.1])
+        q = jnp.asarray([0.05, 1.0, 1.0, 1.0, 1.0])
+        combos = _slate_combos(5, 2)
+        v, top_idx, best = _best_slate_value(scores, q, combos, 5)
+        chosen = {int(top_idx[r]) for r in combos[int(best)]}
+        assert 0 not in chosen, chosen  # clickbait excluded
+        # sanity: its value beats the clickbait-seated slate {0,1}
+        s0 = (50.0 * 0.05 + 2.0 * 1.0) / (52.0 + 1.0)
+        assert float(v) > s0
+
+
 class TestMBPETS:
     def test_model_based_planning_improves_pendulum(self):
         """The model-based family (mbrl.py; reference Dreamer/MBMPO
