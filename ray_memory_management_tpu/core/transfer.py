@@ -193,22 +193,43 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
     fails the fetch instead of hanging the calling thread (and, on an
     agent, instead of pinning the oid unsealed forever, which would block
     the head's push fallback)."""
+    from multiprocessing import AuthenticationError
     from multiprocessing.connection import (
         Connection, answer_challenge, deliver_challenge,
     )
 
-    try:
-        sock = socket.create_connection((host, port),
-                                        timeout=_CONNECT_TIMEOUT)
-        sock.settimeout(None)  # timeouts via SO_RCVTIMEO below
-        conn = Connection(sock.detach())
-        # per-operation bound: a healthy stream always progresses within
-        # seconds; 30s of silence on any single recv means the peer is gone
-        _set_io_timeout(conn.fileno(), min(timeout, 30.0))
-        answer_challenge(conn, authkey)
-        deliver_challenge(conn, authkey)
-    except Exception as e:  # noqa: BLE001 — peer down / auth refused
-        return f"connect to {host}:{port} failed: {e!r}"
+    last_exc: Optional[BaseException] = None
+    conn = None
+    for attempt in range(2):
+        # the connect/handshake phase retries ONCE: nothing has streamed
+        # yet, and on a saturated host a GIL-starved peer can miss even a
+        # generous handshake budget (observed: a full-suite teardown
+        # starving an 8-way fetch's challenge past 30s). Data-phase
+        # failures below stay single-shot — callers own those retries.
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=_CONNECT_TIMEOUT)
+            sock.settimeout(None)  # timeouts via SO_RCVTIMEO below
+            conn = Connection(sock.detach())
+            # per-operation bound: a healthy stream always progresses
+            # within seconds; 30s of silence on any single recv means
+            # the peer is gone
+            _set_io_timeout(conn.fileno(), min(timeout, 30.0))
+            answer_challenge(conn, authkey)
+            deliver_challenge(conn, authkey)
+            break
+        except Exception as e:  # noqa: BLE001 — peer down / auth refused
+            last_exc = e
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+            if isinstance(e, AuthenticationError):
+                break  # a wrong key will not become right on retry
+    if conn is None:
+        return f"connect to {host}:{port} failed: {last_exc!r}"
     try:
         from ..config import WIRE_PROTOCOL_VERSION
 
