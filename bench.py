@@ -316,6 +316,28 @@ def _hw_ceiling():
     return round(gbps, 2)
 
 
+def _metrics_snapshot() -> dict:
+    """Head-registry scrape of the run's observable internals: task
+    counters plus per-stage latency summaries. Gives each BENCH_*.json a
+    view of WHERE the wall-clock went, not just how long it took."""
+    try:
+        from ray_memory_management_tpu import state
+        from ray_memory_management_tpu.utils import metrics as _metrics
+
+        counters = {}
+        with _metrics._registry_lock:
+            registered = list(_metrics._registry.values())
+        for m in registered:
+            if isinstance(m, _metrics.Counter):
+                total = sum(m.series().values())
+                if total:
+                    counters[m.info["name"]] = round(total, 1)
+        return {"task_counters": counters,
+                "task_latencies": state.summarize_task_latencies()}
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        return {"error": repr(e)}
+
+
 def main() -> None:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -339,7 +361,8 @@ def main() -> None:
                 file=sys.stderr,
             )
         gm = geomean(ratios)
-    finally:
+        obs_metrics = _metrics_snapshot()  # before shutdown: needs the
+    finally:                              # live runtime's latency buffers
         rmt.shutdown()
 
     scale = _scale_suite()
@@ -349,7 +372,8 @@ def main() -> None:
     # LAST stdout line stays compact (<1 KB) so the driver's tail window
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
-    detail = {"micro_stats": stats, "scale": scale, "tpu": tpu}
+    detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
+              "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -358,7 +382,7 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "tpu"):
+    for section in ("micro_stats", "scale", "tpu", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))

@@ -145,38 +145,74 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 # ---------------------------------------------------------------- operations
+class _op_timer:
+    """Times one collective op into rmt_collective_latency_seconds. These
+    module functions are the single entry point for BOTH backends (xla
+    mesh and objstore), so per-op latency lands here once."""
+
+    __slots__ = ("_op", "_t0")
+
+    def __init__(self, op: str):
+        self._op = op
+        self._t0 = 0.0
+
+    def __enter__(self):
+        import time as _time
+
+        self._t0 = _time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            import time as _time
+
+            from ..core.metrics_defs import collective_latency_seconds
+
+            collective_latency_seconds().observe(
+                _time.monotonic() - self._t0, tags={"op": self._op})
+        return False
+
+
 def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
-    return _group_mgr.get(group_name).allreduce(tensor, op)
+    with _op_timer("allreduce"):
+        return _group_mgr.get(group_name).allreduce(tensor, op)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = ReduceOp.SUM):
-    return _group_mgr.get(group_name).reduce(tensor, dst_rank, op)
+    with _op_timer("reduce"):
+        return _group_mgr.get(group_name).reduce(tensor, dst_rank, op)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _group_mgr.get(group_name).broadcast(tensor, src_rank)
+    with _op_timer("broadcast"):
+        return _group_mgr.get(group_name).broadcast(tensor, src_rank)
 
 
 def allgather(tensor, group_name: str = "default"):
-    return _group_mgr.get(group_name).allgather(tensor)
+    with _op_timer("allgather"):
+        return _group_mgr.get(group_name).allgather(tensor)
 
 
 def reducescatter(tensor, group_name: str = "default",
                   op: str = ReduceOp.SUM):
-    return _group_mgr.get(group_name).reducescatter(tensor, op)
+    with _op_timer("reducescatter"):
+        return _group_mgr.get(group_name).reducescatter(tensor, op)
 
 
 def barrier(group_name: str = "default"):
-    return _group_mgr.get(group_name).barrier()
+    with _op_timer("barrier"):
+        return _group_mgr.get(group_name).barrier()
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    return _group_mgr.get(group_name).send(tensor, dst_rank)
+    with _op_timer("send"):
+        return _group_mgr.get(group_name).send(tensor, dst_rank)
 
 
 def recv(src_rank: int, group_name: str = "default"):
-    return _group_mgr.get(group_name).recv(src_rank)
+    with _op_timer("recv"):
+        return _group_mgr.get(group_name).recv(src_rank)
 
 
 class CollectiveGroupMixin:

@@ -1,0 +1,170 @@
+"""Canonical core-runtime instrument set (the reference's
+src/ray/stats/metric_defs.cc analog).
+
+Every metric the runtime emits is declared here once — name, type, help
+text, tag keys, bucket boundaries — and call sites fetch instruments via
+the accessor functions. Accessors re-register on demand so the set
+survives ``metrics.clear_registry()`` in tests: construction either
+registers a fresh instrument or aliases the storage of an
+already-registered one (utils/metrics.py _adopt_prior).
+
+Naming follows the Prometheus conventions the reference exporter uses:
+``rmt_`` prefix, ``_total`` suffix on counters, base units in names
+(seconds / bytes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..utils.metrics import Counter, Gauge, Histogram, Metric
+
+# latency buckets: 500us .. 60s, roughly log-spaced — covers scheduler
+# hops (sub-ms) through long collective/transfer ops
+LATENCY_BOUNDARIES = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                      0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+# size buckets: 1KiB .. 4GiB
+BYTES_BOUNDARIES = [float(1 << s) for s in (10, 14, 17, 20, 23, 26, 29, 32)]
+
+# name -> (cls, kwargs); pure data so tests can assert the full set
+DEFS: Dict[str, tuple] = {
+    # task lifecycle (task_events analog)
+    "rmt_tasks_submitted_total": (Counter, dict(
+        description="Tasks submitted to the runtime (incl. actor tasks).")),
+    "rmt_tasks_finished_total": (Counter, dict(
+        description="Tasks that reached FINISHED.")),
+    "rmt_tasks_failed_total": (Counter, dict(
+        description="Tasks that reached FAILED (after retries).")),
+    "rmt_tasks_retried_total": (Counter, dict(
+        description="Task retry attempts (app error or worker death).")),
+    "rmt_task_stage_seconds": (Histogram, dict(
+        description="Per-task time spent in each lifecycle stage.",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("stage",))),
+    # scheduler
+    "rmt_scheduler_placements_total": (Counter, dict(
+        description="Successful pick_node placements.")),
+    "rmt_scheduler_queue_depth": (Gauge, dict(
+        description="Dispatch-queue depth (queued + inflight) per node.",
+        tag_keys=("node_id",))),
+    "rmt_scheduler_pending_args": (Gauge, dict(
+        description="Tasks waiting on argument dependencies.")),
+    # object / device stores
+    "rmt_object_store_bytes": (Gauge, dict(
+        description="Shared-memory object store bytes in use per node.",
+        tag_keys=("node_id",))),
+    "rmt_device_store_bytes": (Gauge, dict(
+        description="Accelerator-resident object bytes (device store).")),
+    "rmt_objects_spilled_total": (Counter, dict(
+        description="Objects spilled to external storage.")),
+    "rmt_objects_spilled_bytes_total": (Counter, dict(
+        description="Bytes spilled to external storage.")),
+    "rmt_objects_restored_total": (Counter, dict(
+        description="Objects restored from external storage.")),
+    "rmt_objects_restored_bytes_total": (Counter, dict(
+        description="Bytes restored from external storage.")),
+    # transfer plane
+    "rmt_transfer_bytes": (Histogram, dict(
+        description="Object payload size per transfer.",
+        boundaries=BYTES_BOUNDARIES, tag_keys=("direction",))),
+    "rmt_transfer_latency_seconds": (Histogram, dict(
+        description="Wall time per object transfer.",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("direction",))),
+    # collectives
+    "rmt_collective_latency_seconds": (Histogram, dict(
+        description="Wall time per collective op.",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("op",))),
+    # liveness
+    "rmt_worker_heartbeat_age_seconds": (Gauge, dict(
+        description="Seconds since each node's last heartbeat.",
+        tag_keys=("node_id",))),
+    # worker-process-side (merged into the head registry via the
+    # done-reply/flush piggyback channel)
+    "rmt_worker_tasks_executed_total": (Counter, dict(
+        description="Tasks executed, counted worker-side.")),
+}
+
+
+def get(name: str) -> Metric:
+    """Fetch (constructing if needed) a canonical instrument by name.
+
+    Construction is idempotent: utils.metrics aliases storage when the
+    name is already registered, so this is cheap enough for emit sites to
+    call per event — but hot paths should still hoist the result."""
+    cls, kw = DEFS[name]
+    return cls(name, **kw)
+
+
+def tasks_submitted() -> Counter:
+    return get("rmt_tasks_submitted_total")
+
+
+def tasks_finished() -> Counter:
+    return get("rmt_tasks_finished_total")
+
+
+def tasks_failed() -> Counter:
+    return get("rmt_tasks_failed_total")
+
+
+def tasks_retried() -> Counter:
+    return get("rmt_tasks_retried_total")
+
+
+def task_stage_seconds() -> Histogram:
+    return get("rmt_task_stage_seconds")
+
+
+def scheduler_placements() -> Counter:
+    return get("rmt_scheduler_placements_total")
+
+
+def scheduler_queue_depth() -> Gauge:
+    return get("rmt_scheduler_queue_depth")
+
+
+def scheduler_pending_args() -> Gauge:
+    return get("rmt_scheduler_pending_args")
+
+
+def object_store_bytes() -> Gauge:
+    return get("rmt_object_store_bytes")
+
+
+def device_store_bytes() -> Gauge:
+    return get("rmt_device_store_bytes")
+
+
+def objects_spilled() -> Counter:
+    return get("rmt_objects_spilled_total")
+
+
+def objects_spilled_bytes() -> Counter:
+    return get("rmt_objects_spilled_bytes_total")
+
+
+def objects_restored() -> Counter:
+    return get("rmt_objects_restored_total")
+
+
+def objects_restored_bytes() -> Counter:
+    return get("rmt_objects_restored_bytes_total")
+
+
+def transfer_bytes() -> Histogram:
+    return get("rmt_transfer_bytes")
+
+
+def transfer_latency_seconds() -> Histogram:
+    return get("rmt_transfer_latency_seconds")
+
+
+def collective_latency_seconds() -> Histogram:
+    return get("rmt_collective_latency_seconds")
+
+
+def worker_heartbeat_age_seconds() -> Gauge:
+    return get("rmt_worker_heartbeat_age_seconds")
+
+
+def worker_tasks_executed() -> Counter:
+    return get("rmt_worker_tasks_executed_total")

@@ -164,11 +164,14 @@ class NodeObjectStore:
                     pass
             if freed:
                 from ..utils import events
+                from . import metrics_defs as mdefs
 
                 events.emit("OBJECT_SPILLED",
                             f"spilled {freed} bytes to external storage",
                             source="object_store", bytes=freed,
                             objects=n_spilled)
+                mdefs.objects_spilled().inc(n_spilled)
+                mdefs.objects_spilled_bytes().inc(freed)
             return freed
 
     def make_room(self, need_bytes: int) -> int:
@@ -298,6 +301,10 @@ class NodeObjectStore:
         # synchronous: a delete queued on the _io pool would be dropped by
         # close()'s shutdown(wait=False), orphaning the spill file
         self._storage.delete(url)
+        from . import metrics_defs as mdefs
+
+        mdefs.objects_restored().inc()
+        mdefs.objects_restored_bytes().inc(len(data))
         return out
 
     def read(self, object_id: bytes):

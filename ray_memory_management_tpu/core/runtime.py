@@ -44,6 +44,7 @@ from ..utils import events
 from .gcs import (
     ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING, ActorRecord, GCS,
 )
+from . import metrics_defs as mdefs
 from .node_manager import NodeManager, WorkerHandle
 from .object_ref import ObjectRef
 from .object_store import StoreClient
@@ -251,9 +252,34 @@ class _SlimFuture:
         raise _FutTimeout()
 
 
+# lifecycle stage spans derived from a task's transition stamps (the
+# reference's task_events state timeline): (stage, from-stamp, to-stamp)
+_STAGE_EDGES = (
+    ("submit_to_queue", "SUBMITTED", "QUEUED"),
+    ("queue_to_schedule", "QUEUED", "SCHEDULED"),
+    ("schedule_to_dispatch", "SCHEDULED", "DISPATCHED"),
+    ("dispatch_to_run", "DISPATCHED", "RUNNING"),
+    ("run", "RUNNING", "WORKER_DONE"),
+    ("total", "SUBMITTED", "FINISHED"),
+)
+
+
+def stage_durations(ts: Dict[str, float]) -> Dict[str, float]:
+    """Stage -> seconds from whichever transition stamps are present
+    (actor tasks skip the queue/schedule stages; failed tasks have no
+    FINISHED). Negative spans (clock adjustments) are dropped."""
+    out: Dict[str, float] = {}
+    for stage, a, b in _STAGE_EDGES:
+        ta = ts.get(a)
+        tb = ts.get(b)
+        if ta is not None and tb is not None and tb >= ta:
+            out[stage] = tb - ta
+    return out
+
+
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "state", "payload",
-                 "args_released", "gc_returns")
+                 "args_released", "gc_returns", "ts")
 
     def __init__(self, spec: TaskSpec, payload: dict, retries_left: int,
                  gc_returns: bool = True):
@@ -261,6 +287,9 @@ class _TaskRecord:
         self.payload = payload  # original submission payload, for resubmit
         self.retries_left = retries_left
         self.state = "PENDING"
+        # state-transition stamps (time.time()); worker-side RUNNING /
+        # WORKER_DONE merge in from the done reply's piggybacked tstamps
+        self.ts: Dict[str, float] = {"SUBMITTED": time.time()}
         # the task holds a reference on each of its ref args until it
         # reaches a terminal state (reference_count.h task-argument refs);
         # this flag makes the release idempotent across the several
@@ -358,6 +387,17 @@ class Runtime:
         # (the reference's GcsTaskManager keeps a capped task-event log
         # for the same reason); entries are tiny summary dicts
         self.task_history: deque = deque(maxlen=10_000)
+        # per-stage latency samples (bounded) for exact percentile
+        # summaries (state.summarize_task_latencies); the stage histogram
+        # metric keeps the unbounded bucketed view
+        self.task_latencies: Dict[str, deque] = {}
+        # hot-path instruments hoisted once (accessor calls touch the
+        # registry lock)
+        self._m_submitted = mdefs.tasks_submitted()
+        self._m_finished = mdefs.tasks_finished()
+        self._m_failed = mdefs.tasks_failed()
+        self._m_retried = mdefs.tasks_retried()
+        self._m_stage_hist = mdefs.task_stage_seconds()
         # dep-ready tasks awaiting scheduling, drained in BATCHES by the
         # router's pump: per-task inline scheduling cost ~7 lock/notify
         # round-trips; batching pays them once per burst (the reference
@@ -1019,10 +1059,20 @@ class Runtime:
             # that race would misread a live object as lost).
             self._on_owned_put(handle, msg)
         elif mtype == "profile":
-            # straggler span batch from an idling worker's flush ticker
+            # flush frame from a worker's ticker (or its final exit
+            # flush): straggler spans, plus optional piggybacked event
+            # and metric-series batches that merge into the head's
+            # buffers/registry (the agent->head aggregation path)
             from ..utils import timeline
 
-            timeline.ingest_events(msg["profile"])
+            if msg.get("profile"):
+                timeline.ingest_events(msg["profile"])
+            if msg.get("events"):
+                events.ingest(msg["events"])
+            if msg.get("series"):
+                from ..utils import metrics as _metrics
+
+                _metrics.merge_series(msg["series"])
         elif mtype == "pong":
             pass
         else:
@@ -1083,6 +1133,7 @@ class Runtime:
         )
         rec = _TaskRecord(spec, payload, spec.max_retries,
                           gc_returns=adopt_returns)
+        self._m_submitted.inc()
         with self._lock:
             self.tasks[spec.task_id] = rec
             with self._ref_mu:
@@ -1124,6 +1175,9 @@ class Runtime:
             for oid in missing:
                 self._dep_waiters[oid].append(spec.task_id)
             return False
+        rec = self.tasks.get(spec.task_id)
+        if rec is not None:
+            rec.ts["QUEUED"] = time.time()
         self._submit_q.append(spec)
         if self._submit_nudged:
             return False
@@ -1152,6 +1206,7 @@ class Runtime:
                 del self._waiting_deps[task_id]
                 rec = self.tasks.get(task_id)
                 if rec:
+                    rec.ts["QUEUED"] = time.time()
                     self._submit_q.append(rec.spec)
                     if not self._submit_nudged:
                         self._submit_nudged = True
@@ -1189,6 +1244,9 @@ class Runtime:
             rec = self.tasks.get(spec.task_id)
             if rec:
                 rec.state = "FAILED"
+                rec.ts["FAILED"] = time.time()
+        if rec:
+            self._m_failed.inc()
         self._release_task_args(spec)
 
     def _schedule(self, spec: TaskSpec, pump: bool = True) -> None:
@@ -1229,6 +1287,7 @@ class Runtime:
             rec = self.tasks.get(spec.task_id)
             if rec:
                 rec.state = "SCHEDULED"
+                rec.ts["SCHEDULED"] = time.time()
         if not pump:
             return  # router pump dispatches for the whole batch
         if had_backlog:
@@ -1556,6 +1615,10 @@ class Runtime:
             ok = self._sender_enqueue(handle, msg)
         if not ok:
             self._on_worker_death(handle)
+            return
+        rec = self.tasks.get(spec.task_id)  # lock-free: dict read + stamp
+        if rec is not None:
+            rec.ts["DISPATCHED"] = time.time()
 
     def _task_msg(self, handle: WorkerHandle, spec: TaskSpec) -> dict:
         args = [self._finalize_arg(a) for a in spec.args]
@@ -1631,6 +1694,7 @@ class Runtime:
             exc = ser.loads(m["error"])
             if rec and spec and rec.retries_left > 0 and spec.retry_exceptions:
                 rec.retries_left -= 1
+                self._m_retried.inc()
                 events.emit(
                     "TASK_RETRY",
                     f"retrying {spec.name} after {type(exc).__name__}",
@@ -1644,6 +1708,8 @@ class Runtime:
             return
         nudge = False
         to_free: List[bytes] = []
+        done_t = time.time()  # one stamp for the whole burst
+        stage_durs: List[Dict[str, float]] = []
         with self._lock:
             for m, spec in simple:
                 for oid, kind, data in m["returns"]:
@@ -1665,6 +1731,11 @@ class Runtime:
                 rec = self.tasks.get(m["task_id"])
                 if rec:
                     rec.state = "FINISHED"
+                    wt = m.get("tstamps")
+                    if wt:
+                        rec.ts.update(wt)
+                    rec.ts["FINISHED"] = done_t
+                    stage_durs.append(stage_durations(rec.ts))
                 # arg release + fire-and-forget GC stay inside the batch
                 # lock (per-task locking was the completion side's
                 # dominant cost); only the zero-ref free_object calls run
@@ -1688,9 +1759,27 @@ class Runtime:
                             roid for roid in spec.return_ids
                             if roid not in self.local_refs)
         _SlimFuture.broadcast()  # wake getters once for the whole burst
+        self._m_finished.inc(len(simple))
+        if stage_durs:
+            self._record_task_latencies(stage_durs)
         self.free_objects(to_free)
         if nudge:
             self._wakeup()
+
+    def _record_task_latencies(self,
+                               durs_list: List[Dict[str, float]]) -> None:
+        """Fold finished tasks' stage durations into the bounded
+        percentile buffers and the stage histogram (outside the batch
+        lock — histogram observes take the instrument lock)."""
+        hist = self._m_stage_hist
+        lat = self.task_latencies
+        for durs in durs_list:
+            for stage, d in durs.items():
+                buf = lat.get(stage)
+                if buf is None:
+                    buf = lat[stage] = deque(maxlen=4096)
+                buf.append(d)
+                hist.observe(d, tags={"stage": stage})
 
     # --------------------------------------------------------------- actors
     def create_actor(self, payload: dict) -> bytes:
@@ -1876,6 +1965,7 @@ class Runtime:
         )
         rec = _TaskRecord(spec, payload, info.spec.max_task_retries,
                           gc_returns=adopt_returns)
+        self._m_submitted.inc()
         with self._lock:
             self.tasks[spec.task_id] = rec
             with self._ref_mu:
@@ -2097,6 +2187,7 @@ class Runtime:
             if can_retry:
                 rec.retries_left -= 1
         if can_retry:
+            self._m_retried.inc()
             events.emit("TASK_RETRY",
                         f"retrying {spec.name} after {type(exc).__name__}",
                         severity=events.WARNING, source="core_worker",
@@ -2197,7 +2288,49 @@ class Runtime:
                         self._on_worker_death(h)
             for node_id in self.gcs.check_heartbeats(timeout):
                 self.remove_node(node_id)
+            try:
+                self._refresh_gauges(nodes)
+            except Exception:
+                pass  # sampling must never kill the heartbeat loop
             self._stop.wait(interval)
+
+    def _refresh_gauges(self, nodes: Optional[List[NodeManager]] = None
+                        ) -> None:
+        """Heartbeat-period sample of cluster-level gauges (the
+        reference's periodic stats collection): per-node dispatch-queue
+        depth and object-store bytes, pending-dependency count,
+        device-store bytes, heartbeat age."""
+        if nodes is None:
+            with self._lock:
+                nodes = list(self.nodes.values())
+        self.scheduler.publish_load()
+        store_g = mdefs.object_store_bytes()
+        hb_g = mdefs.worker_heartbeat_age_seconds()
+        now_mono = time.monotonic()
+        for nm in nodes:
+            if not nm.alive:
+                continue
+            nid = nm.node_id.hex()[:12]
+            store = getattr(nm, "store", None)
+            if store is not None and hasattr(store, "usage"):
+                try:
+                    used = store.usage()[0]
+                    store_g.set(float(used), tags={"node_id": nid})
+                except Exception:
+                    pass
+            info = self.gcs.nodes.get(nm.node_id)
+            if info is not None:
+                hb_g.set(max(0.0, now_mono - info.last_heartbeat),
+                         tags={"node_id": nid})
+        with self._lock:
+            pending = len(self._waiting_deps)
+        mdefs.scheduler_pending_args().set(float(pending))
+        dev_bytes = 0
+        for oid in self.device_store.ids():
+            n = self.device_store.nbytes(oid)
+            if n:
+                dev_bytes += n
+        mdefs.device_store_bytes().set(float(dev_bytes))
 
     # --------------------------------------------------------- device objects
     def put_device_object(self, value: Any) -> bytes:
@@ -2786,7 +2919,7 @@ class Runtime:
                 # lazily on read
                 self.task_history.append(
                     (tid, rec.spec.name, rec.state, rec.spec.num_returns,
-                     rec.retries_left, rec.spec.is_actor_task))
+                     rec.retries_left, rec.spec.is_actor_task, rec.ts))
                 del self.tasks[tid]
                 for a in self._ref_deps(rec.spec):
                     n = self._lineage_dependents.get(a, 0) - 1

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 from ..config import Config
 from ..ids import NodeID
 from .gcs import GCS
+from .metrics_defs import scheduler_placements, scheduler_queue_depth
 from .resources import NodeResources, Resources
 from .scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
@@ -43,10 +44,27 @@ class ClusterScheduler:
         # queued-task depth per node (injected by the runtime); used to
         # balance leases when every feasible node is at capacity
         self.load_fn = load_fn or (lambda node_id: 0)
+        self._m_placements = scheduler_placements()
 
     # -- policy entry ---------------------------------------------------------
     def pick_node(self, req: Resources, strategy=None,
                   queue_if_busy: bool = True) -> Optional[NodeID]:
+        node_id = self._pick_node(req, strategy, queue_if_busy)
+        if node_id is not None:
+            self._m_placements.inc()
+        return node_id
+
+    def publish_load(self) -> None:
+        """Refresh the per-node dispatch-queue-depth gauge (called from
+        the runtime's heartbeat loop — not per pick, which is the task
+        hot path)."""
+        g = scheduler_queue_depth()
+        for n in self.gcs.alive_nodes():
+            g.set(float(self.load_fn(n.node_id)),
+                  tags={"node_id": n.node_id.hex()[:12]})
+
+    def _pick_node(self, req: Resources, strategy=None,
+                   queue_if_busy: bool = True) -> Optional[NodeID]:
         """Select a node to lease the task to.
 
         With ``queue_if_busy`` (the task path) a task always lands on SOME

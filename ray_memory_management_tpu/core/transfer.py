@@ -32,6 +32,19 @@ from typing import Optional
 _CONNECT_TIMEOUT = 20.0
 
 
+def _observe_transfer(direction: str, nbytes: int, seconds: float) -> None:
+    """Record one completed transfer in the size/latency histograms; never
+    lets instrumentation fail a transfer."""
+    try:
+        from . import metrics_defs as mdefs
+
+        tags = {"direction": direction}
+        mdefs.transfer_bytes().observe(float(nbytes), tags=tags)
+        mdefs.transfer_latency_seconds().observe(seconds, tags=tags)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _set_io_timeout(fd: int, seconds: float) -> None:
     """SO_RCVTIMEO/SO_SNDTIMEO on the connection's underlying socket
     (options live in the shared kernel socket, so setting them through a
@@ -125,6 +138,7 @@ class TransferServer:
                 if view is None:
                     conn.send({"error": "object not in store"})
                     return
+                t0 = time.monotonic()
                 try:
                     n = len(view) if isinstance(view, bytes) else view.nbytes
                     conn.send({"size": n})
@@ -134,6 +148,7 @@ class TransferServer:
                             conn.send_bytes(mv[off:off + self.chunk_size])
                     finally:
                         mv.release()
+                    _observe_transfer("serve", n, time.monotonic() - t0)
                 finally:
                     if isinstance(view, memoryview):
                         self.store.release(oid)
@@ -230,6 +245,7 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                 break  # a wrong key will not become right on retry
     if conn is None:
         return f"connect to {host}:{port} failed: {last_exc!r}"
+    t0 = time.monotonic()
     try:
         from ..config import WIRE_PROTOCOL_VERSION
 
@@ -261,6 +277,7 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                 pass
             raise
         dst_store.seal(oid)
+        _observe_transfer("pull", size, time.monotonic() - t0)
         return None
     except (EOFError, OSError) as e:
         return f"transfer from {host}:{port} failed: {e!r}"

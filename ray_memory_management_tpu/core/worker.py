@@ -46,6 +46,21 @@ from .object_store import StoreClient
 PRELOADED_CLASSES: Dict[bytes, Any] = {}
 
 
+_m_executed = None
+
+
+def _inc_executed() -> None:
+    """Worker-side tasks-executed counter; lazily bound so the instrument
+    registers in the WORKER's registry (its deltas merge into the head
+    via the flush channel)."""
+    global _m_executed
+    if _m_executed is None:
+        from . import metrics_defs as mdefs
+
+        _m_executed = mdefs.worker_tasks_executed()
+    _m_executed.inc()
+
+
 class _ReplySender:
     """Reply writer owned by one persistent drain thread (the mirror of the
     runtime's _sender_enqueue): every enqueued reply is coalesced with
@@ -79,6 +94,12 @@ class _ReplySender:
             return True
         except (OSError, BrokenPipeError, ValueError):
             return False
+
+    def send_now(self, msg: dict) -> bool:
+        """Synchronous write, bypassing the drain thread — the exit-flush
+        path, where os._exit follows immediately and a queued message
+        would die with the process."""
+        return self._write(msg)
 
     def _drain_loop(self) -> None:
         while True:
@@ -704,6 +725,10 @@ class Worker:
         args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"task::{msg.get('name', 'task')}", t0)
+        # worker-side lifecycle stamps ride the reply; the owner merges
+        # them into the task's transition record (task_events analog)
+        reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
+        _inc_executed()
         # borrowed-ref table + buffered releases ride the done reply
         # (reference_count.h:139-156: the borrowed-ref table ships back
         # on task completion) — zero extra pipe writes
@@ -860,6 +885,8 @@ class Worker:
         args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0)
+        reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
+        _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
 
@@ -894,6 +921,8 @@ class Worker:
         fut = None  # noqa: F841
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0)
+        reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
+        _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
 
@@ -930,19 +959,58 @@ class Worker:
                          name="log-capture").start()
 
     # -- main loop ------------------------------------------------------------
+    def _flush_frame(self, spans: List[dict]) -> Optional[dict]:
+        """Build one combined flush frame: straggler timeline spans plus
+        this process's buffered events and metric-series deltas (the
+        agent→head aggregation ride-along). None when nothing moved."""
+        from ..utils import events as _events
+        from ..utils import metrics as _metrics
+
+        evs = _events.drain_events()
+        try:
+            series = _metrics.snapshot_deltas()
+        except Exception:  # noqa: BLE001 — never block the flush on stats
+            series = []
+        if not (spans or evs or series):
+            return None
+        frame: dict = {"type": "profile", "profile": spans or []}
+        if evs:
+            frame["events"] = evs
+        if series:
+            frame["series"] = series
+        return frame
+
     def _profile_flush_loop(self) -> None:
         """Straggler profile spans: the done-reply path batches spans
         (drain_events_if_due), so an idle worker could sit on a tail of
         undelivered spans forever — this 1 s ticker ships them as a
-        standalone frame. No-op (no send, no wakeups) while empty."""
+        standalone frame (with piggybacked events + metric deltas).
+        No-op (no send, no wakeups) while empty."""
         from ..utils import timeline
 
         while not self._shutdown.is_set():
             self._shutdown.wait(1.0)
             evs = timeline.drain_events_if_due(min_batch=1,
                                                max_age_s=1.0)
-            if evs:
-                self.sender.send({"type": "profile", "profile": evs})
+            frame = self._flush_frame(evs)
+            if frame:
+                self.sender.send(frame)
+
+    def _final_flush(self) -> None:
+        """Unconditional exit flush: spans/events/metric deltas buffered
+        since the last ticker tick would die with os._exit — drain
+        everything and write SYNCHRONOUSLY (the sender's drain thread may
+        never be scheduled again). Failures are moot: if the pipe is
+        already closed the head has moved on."""
+        try:
+            from ..utils import timeline
+
+            spans = timeline.drain_events_if_due(min_batch=1, max_age_s=0.0)
+            frame = self._flush_frame(spans)
+            if frame:
+                self.sender.send_now(frame)
+        except Exception:  # noqa: BLE001 — exiting anyway
+            pass
 
     def run(self) -> None:
         from .. import _worker_context
@@ -975,6 +1043,7 @@ class Worker:
             msgs = msg["msgs"] if msg["type"] == "batch" else (msg,)
             for m in msgs:
                 self._dispatch(m)
+        self._final_flush()
         os._exit(0)  # skip atexit: the store mapping may hold live views
 
     def _dispatch(self, msg: dict) -> None:

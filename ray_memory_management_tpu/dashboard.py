@@ -14,6 +14,8 @@ Routes::
     /api/jobs               job-submission table
     /api/drivers            GCS job table (driver + client jobs)
     /api/events             structured cluster events
+    /api/task_summary       task-state counts + per-stage latency p50/95/99
+    /api/timeline           Chrome traceEvents JSON (load in Perfetto)
     /metrics                Prometheus exposition text
 """
 
@@ -125,6 +127,15 @@ class Dashboard:
             from .utils import events as _events
 
             data = _events.list_events()
+        elif path == "/api/task_summary":
+            data = {
+                "tasks": state.summarize_tasks(),
+                "latencies": state.summarize_task_latencies(),
+            }
+        elif path == "/api/timeline":
+            from .utils import timeline as _timeline
+
+            data = _timeline.chrome_trace_events()
         else:
             return 404, "application/json", b'{"error": "not found"}'
         return 200, "application/json", json.dumps(data).encode()

@@ -100,6 +100,25 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_summary(args) -> int:
+    """Task-state counts plus per-lifecycle-stage latency percentiles of
+    the runtime in THIS process (the ``ray summary tasks`` analog). Like
+    ``memory``, this reads the in-process runtime — call main(['summary'])
+    from a driver."""
+    from ray_memory_management_tpu import _worker_context, state
+
+    if _worker_context.get_runtime() is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['summary']))",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "tasks": state.summarize_tasks(),
+        "latencies": state.summarize_task_latencies(),
+    }, indent=2))
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -244,6 +263,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("memory", help="object store summary")
     s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser(
+        "summary",
+        help="task-state counts + per-stage latency p50/p95/p99")
+    s.set_defaults(fn=cmd_summary)
 
     s = sub.add_parser("microbenchmark",
                        help="run the core microbenchmark suite")

@@ -16,5 +16,6 @@ from .api import (  # noqa: F401
     list_workers,
     summarize_actors,
     summarize_objects,
+    summarize_task_latencies,
     summarize_tasks,
 )

@@ -87,11 +87,14 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
         # (runtime.task_history; the reference's GcsTaskManager log) —
         # stored as raw tuples on the completion hot path, rendered here
         history = list(rt.task_history)
+    from ..core.runtime import stage_durations
+
     rows = [{
         "task_id": tid.hex(), "name": name, "state": state,
         "num_returns": nret, "retries_left": retries,
         "is_actor_task": is_actor,
-    } for tid, name, state, nret, retries, is_actor in history]
+        "durations": stage_durations(ts),
+    } for tid, name, state, nret, retries, is_actor, ts in history]
     for task_id, rec in records:
         rows.append({
             "task_id": task_id.hex(),
@@ -100,6 +103,7 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "num_returns": rec.spec.num_returns,
             "retries_left": rec.retries_left,
             "is_actor_task": rec.spec.is_actor_task,
+            "durations": stage_durations(rec.ts),
         })
     return _apply_filters(rows, filters)[:limit]
 
@@ -217,3 +221,34 @@ def summarize_objects() -> Dict[str, Any]:
     rows = list_objects()
     total_bytes = sum(r["size_bytes"] or 0 for r in rows)
     return {"count": len(rows), "total_bytes": total_bytes}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize_task_latencies() -> Dict[str, Dict[str, float]]:
+    """Per-lifecycle-stage latency summary (count / mean / p50 / p95 /
+    p99, milliseconds) over the runtime's bounded stage-duration samples
+    — the ``ray summary tasks`` timing breakdown analog. Exact
+    percentiles from raw samples, not bucket interpolation (the
+    rmt_task_stage_seconds histogram serves the monitoring view)."""
+    rt = _runtime()
+    out: Dict[str, Dict[str, float]] = {}
+    for stage, buf in list(rt.task_latencies.items()):
+        vals = sorted(buf)
+        if not vals:
+            continue
+        out[stage] = {
+            "count": len(vals),
+            "mean_ms": (sum(vals) / len(vals)) * 1e3,
+            "p50_ms": _percentile(vals, 0.50) * 1e3,
+            "p95_ms": _percentile(vals, 0.95) * 1e3,
+            "p99_ms": _percentile(vals, 0.99) * 1e3,
+        }
+    return out

@@ -232,7 +232,12 @@ def export_prometheus() -> str:
         metrics = list(_registry.values())
     for m in metrics:
         name = m.info["name"]
-        lines.append(f"# HELP {name} {m.info['description']}")
+        # help text escapes per the exposition spec (\ and newline);
+        # an unescaped newline would split the HELP line and corrupt
+        # the whole scrape
+        desc = str(m.info["description"]).replace(
+            "\\", r"\\").replace("\n", r"\n")
+        lines.append(f"# HELP {name} {desc}")
         if isinstance(m, Counter):
             lines.append(f"# TYPE {name} counter")
             for key, v in m.series().items():
@@ -261,3 +266,106 @@ def export_prometheus() -> str:
 def clear_registry() -> None:
     with _registry_lock:
         _registry.clear()
+    with _snapshot_lock:
+        _snapshot_baseline.clear()
+
+
+# -- cross-process aggregation -------------------------------------------------
+# Workers (and remote node agents) keep their own process-local registry;
+# their series ride the existing piggyback channels to the head and merge
+# into ITS registry so /metrics reflects the whole cluster (the reference's
+# per-node metrics agent -> head aggregation, metric_exporter.h). Counters
+# and histograms ship DELTAS against a per-process baseline so repeated
+# flushes never double-count; gauges ship last values.
+
+_snapshot_lock = threading.Lock()
+_snapshot_baseline: Dict[str, dict] = {}
+
+
+def snapshot_deltas() -> List[dict]:
+    """Worker-side: serialize every registered metric's series as a list of
+    plain dicts (pickle-friendly), shipping only what changed since the
+    previous call. Returns [] when nothing moved."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out: List[dict] = []
+    with _snapshot_lock:
+        for m in metrics:
+            info = m.info
+            name = info["name"]
+            if isinstance(m, Counter):
+                base = _snapshot_baseline.setdefault(name, {})
+                deltas = {}
+                for key, v in m.series().items():
+                    d = v - base.get(key, 0.0)
+                    if d > 0:
+                        deltas[key] = d
+                    base[key] = v
+                if deltas:
+                    out.append({"kind": "counter", "name": name,
+                                "description": info["description"],
+                                "tag_keys": list(info["tag_keys"]),
+                                "series": deltas})
+            elif isinstance(m, Histogram):
+                base = _snapshot_baseline.setdefault(name, {})
+                deltas = {}
+                for key, (counts, s, total) in m.series().items():
+                    bc, bs, bt = base.get(
+                        key, ([0] * len(counts), 0.0, 0))
+                    dc = [a - b for a, b in zip(counts, bc)]
+                    if any(dc):
+                        deltas[key] = (dc, s - bs, total - bt)
+                    base[key] = (list(counts), s, total)
+                if deltas:
+                    out.append({"kind": "histogram", "name": name,
+                                "description": info["description"],
+                                "tag_keys": list(info["tag_keys"]),
+                                "boundaries": list(m._boundaries),
+                                "series": deltas})
+            elif isinstance(m, Gauge):
+                series = m.series()
+                if series:
+                    out.append({"kind": "gauge", "name": name,
+                                "description": info["description"],
+                                "tag_keys": list(info["tag_keys"]),
+                                "series": series})
+    return out
+
+
+def merge_series(snapshots: List[dict]) -> None:
+    """Head-side: fold a ``snapshot_deltas()`` batch from another process
+    into this registry. Instruments are (re)constructed by name — the
+    normal aliasing path — then storage is updated directly under the
+    instrument lock (counter deltas add, gauge values overwrite, histogram
+    bucket deltas add)."""
+    for snap in snapshots or ():
+        try:
+            kind = snap["kind"]
+            name = snap["name"]
+            desc = snap.get("description", "")
+            keys = tuple(snap.get("tag_keys") or ())
+            if kind == "counter":
+                m = Counter(name, desc, tag_keys=keys)
+                with m._lock:
+                    for key, d in snap["series"].items():
+                        m._values[key] = m._values.get(key, 0.0) + d
+            elif kind == "gauge":
+                m = Gauge(name, desc, tag_keys=keys)
+                with m._lock:
+                    for key, v in snap["series"].items():
+                        m._values[key] = float(v)
+            elif kind == "histogram":
+                m = Histogram(name, desc,
+                              boundaries=snap["boundaries"], tag_keys=keys)
+                with m._lock:
+                    for key, (dc, dsum, dtotal) in snap["series"].items():
+                        cur = m._counts.setdefault(
+                            key, [0] * (len(m._boundaries) + 1))
+                        for i, c in enumerate(dc):
+                            cur[i] += c
+                        m._sums[key] = m._sums.get(key, 0.0) + dsum
+                        m._totals[key] = m._totals.get(key, 0) + dtotal
+        except (KeyError, ValueError, TypeError):
+            # malformed frame or a name/type clash with a head-registered
+            # metric: drop that one series, never poison the router thread
+            continue
