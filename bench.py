@@ -262,6 +262,50 @@ def _tpu_suite():
     return out
 
 
+# transfer-plane fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): the three v2 wins —
+# pooled small-pull latency, striping, chain-vs-naive source egress.
+REQUIRED_TRANSFER_FIELDS = (
+    "small_pull_p50_us_pooled", "small_pull_p50_us_fresh", "pool_speedup",
+    "pool_hit_rate", "single_stream_gbps", "striped_gbps",
+    "stripe_requests", "broadcast_chain_gbps", "naive_gbps",
+    "naive_source_bytes", "chain_max_source_bytes",
+)
+
+
+def _transfer_suite():
+    """Transfer-plane microbench (utils/transfer_bench.py); fault-isolated
+    so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.transfer_bench import (
+            run_transfer_microbench,
+        )
+
+        out = run_transfer_microbench()
+        print(
+            "  transfer small-pull p50: "
+            f"{out['small_pull_p50_us_pooled']:.0f} us pooled vs "
+            f"{out['small_pull_p50_us_fresh']:.0f} us fresh "
+            f"({out['pool_speedup']:.2f}x, hit rate "
+            f"{out['pool_hit_rate']:.2%})", file=sys.stderr)
+        print(
+            f"  transfer large pull: {out['striped_gbps']:.2f} GB/s "
+            f"striped vs {out['single_stream_gbps']:.2f} GB/s single "
+            f"({out['stripe_requests']} range requests)", file=sys.stderr)
+        print(
+            f"  transfer {out['n_dests']}-dest chain: "
+            f"{out['broadcast_chain_gbps']:.2f} GB/s, max source egress "
+            f"{out['chain_max_source_bytes']:,} B vs naive "
+            f"{out['naive_source_bytes']:,} B", file=sys.stderr)
+        missing = [k for k in REQUIRED_TRANSFER_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  transfer suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -365,6 +409,7 @@ def main() -> None:
     finally:                              # live runtime's latency buffers
         rmt.shutdown()
 
+    transfer = _transfer_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
 
@@ -373,7 +418,7 @@ def main() -> None:
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
-              "metrics": obs_metrics}
+              "transfer": transfer, "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -382,16 +427,17 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "tpu", "metrics"):
+    for section in ("micro_stats", "scale", "tpu", "transfer", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
-                        tpu))
+                        tpu, transfer))
 
 
-def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu):
+def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
+                  transfer=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -416,6 +462,17 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu):
               "single_client_put_gigabytes") if k in stats}
     if micro:
         line["micro"] = {k: round(v, 1) for k, v in micro.items()}
+    if transfer and "error" not in transfer:
+        # the two acceptance numbers: handshake amortization and
+        # source-egress flattening (naive / chain-max = destination count
+        # when the chain fully offloads the source)
+        line["transfer"] = {
+            "pool_speedup": transfer["pool_speedup"],
+            "small_pull_p50_us": transfer["small_pull_p50_us_pooled"],
+            "egress_flatten": round(
+                transfer["naive_source_bytes"]
+                / max(transfer["chain_max_source_bytes"], 1), 2),
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -438,7 +495,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu):
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("micro", "scale"):
+        for k in ("transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

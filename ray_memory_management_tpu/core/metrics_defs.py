@@ -69,6 +69,21 @@ DEFS: Dict[str, tuple] = {
     "rmt_transfer_latency_seconds": (Histogram, dict(
         description="Wall time per object transfer.",
         boundaries=LATENCY_BOUNDARIES, tag_keys=("direction",))),
+    "rmt_transfer_stripe_requests_total": (Counter, dict(
+        description="Range (partial-object) requests served — each stripe "
+                    "of a striped pull is one.")),
+    "rmt_transfer_striped_fetches_total": (Counter, dict(
+        description="Pulls that used the striped multi-connection path.")),
+    "rmt_transfer_pool_hits_total": (Counter, dict(
+        description="Transfer connections reused from the pool "
+                    "(handshake amortized).")),
+    "rmt_transfer_pool_misses_total": (Counter, dict(
+        description="Transfer connections freshly dialed (pool empty "
+                    "for the peer, or pooling disabled).")),
+    "rmt_transfer_broadcast_waits_total": (Counter, dict(
+        description="Multi-destination pulls that waited at the broadcast "
+                    "gate for an earlier copy to land (then pulled from a "
+                    "new holder instead of the original source).")),
     # collectives
     "rmt_collective_latency_seconds": (Histogram, dict(
         description="Wall time per collective op.",
@@ -156,6 +171,26 @@ def transfer_bytes() -> Histogram:
 
 def transfer_latency_seconds() -> Histogram:
     return get("rmt_transfer_latency_seconds")
+
+
+def transfer_stripe_requests() -> Counter:
+    return get("rmt_transfer_stripe_requests_total")
+
+
+def transfer_striped_fetches() -> Counter:
+    return get("rmt_transfer_striped_fetches_total")
+
+
+def transfer_pool_hits() -> Counter:
+    return get("rmt_transfer_pool_hits_total")
+
+
+def transfer_pool_misses() -> Counter:
+    return get("rmt_transfer_pool_misses_total")
+
+
+def transfer_broadcast_waits() -> Counter:
+    return get("rmt_transfer_broadcast_waits_total")
 
 
 def collective_latency_seconds() -> Histogram:

@@ -115,14 +115,21 @@ class NodeAgent:
         # object_manager.h:114)
         from concurrent.futures import ThreadPoolExecutor
 
-        from .transfer import TransferServer, fetch_object as _fetch_object
+        from .transfer import (
+            ConnectionPool, TransferServer, fetch_object as _fetch_object,
+        )
 
         self._fetch_object = _fetch_object
         self._shm_peers: Dict[str, Any] = {}  # same-host peer store maps
         self.transfer_server = TransferServer(
-            self.store, authkey, self.config.object_manager_chunk_size)
+            self.store, authkey, self.config.object_manager_chunk_size,
+            max_conns=self.config.transfer_max_conns,
+            idle_timeout=self.config.transfer_idle_timeout_s)
+        # authenticated peer connections reused across pulls
+        self._xfer_conn_pool = ConnectionPool(
+            max_idle_per_peer=self.config.transfer_pool_size)
         self._fetch_pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="agent-fetch")
+            max_workers=8, thread_name_prefix="agent-fetch")
         self._send({
             "type": "transfer_ready",
             "host": self._my_ip,
@@ -487,7 +494,10 @@ class NodeAgent:
                 try:
                     err = self._fetch_object(
                         host, port, self._cluster_authkey, oid, self.store,
-                        self.config.object_manager_chunk_size)
+                        self.config.object_manager_chunk_size,
+                        pool=self._xfer_conn_pool,
+                        stripe_threshold=self.config.transfer_stripe_threshold,
+                        stripe_count=self.config.transfer_stripe_count)
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
             try:
@@ -726,6 +736,10 @@ class NodeAgent:
         self._stop.set()
         try:
             self.transfer_server.close()
+        except Exception:
+            pass
+        try:
+            self._xfer_conn_pool.close()
         except Exception:
             pass
         self._fetch_pool.shutdown(wait=False)

@@ -50,6 +50,12 @@ class NodeObjectStore:
         self._restore_mu = threading.Lock()
         self._restoring: Dict[bytes, threading.Event] = {}
         self._spilled: Dict[bytes, str] = {}  # object_id -> url
+        # broadcast on any object becoming readable or disappearing
+        # (seal/put/restore/delete): racing fetches parked in
+        # transfer.create_or_wait wake immediately instead of poll-ticking.
+        # Cross-PROCESS seals (StoreClient writes through the shm segment
+        # directly) can't notify — waiters keep a short poll backstop.
+        self._change_cond = threading.Condition()
         # ensure_resident pins: object_id -> (ref-holding view, expiry)
         self._pinned: Dict[bytes, tuple] = {}
         # scope the spill tier per store: several stores on one host (head +
@@ -63,16 +69,29 @@ class NodeObjectStore:
             thread_name_prefix=f"io-{name.strip('/')}",
         )
 
+    def _notify_object_change(self) -> None:
+        with self._change_cond:
+            self._change_cond.notify_all()
+
+    def wait_for_object_change(self, timeout: float) -> None:
+        """Block until SOME object is sealed/deleted/restored in this
+        process (or ``timeout`` elapses). Callers re-check their own
+        predicate — this is a wakeup, not a promise about a specific oid."""
+        with self._change_cond:
+            self._change_cond.wait(timeout)
+
     # -- write path -----------------------------------------------------------
     def put_serialized(self, object_id: bytes, serialized: SerializedObject) -> None:
         buf = self._create_with_spill(object_id, serialized.total_size)
         serialized.write_into(buf)
         self.shm.seal(object_id)
+        self._notify_object_change()
 
     def put_bytes(self, object_id: bytes, data) -> None:
         buf = self._create_with_spill(object_id, len(data))
         buf[:] = data
         self.shm.seal(object_id)
+        self._notify_object_change()
 
     def create(self, object_id: bytes, size: int,
                timeout_s: Optional[float] = None) -> memoryview:
@@ -84,6 +103,7 @@ class NodeObjectStore:
 
     def seal(self, object_id: bytes) -> None:
         self.shm.seal(object_id)
+        self._notify_object_change()
 
     def _create_with_spill(self, object_id: bytes, size: int,
                            timeout_s: Optional[float] = None) -> memoryview:
@@ -305,6 +325,7 @@ class NodeObjectStore:
 
         mdefs.objects_restored().inc()
         mdefs.objects_restored_bytes().inc(len(data))
+        self._notify_object_change()
         return out
 
     def read(self, object_id: bytes):
@@ -345,6 +366,7 @@ class NodeObjectStore:
         if url:
             self._storage.delete(url)
         self.shm.delete(object_id)
+        self._notify_object_change()
 
     def usage(self):
         return self.shm.usage()

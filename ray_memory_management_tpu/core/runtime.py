@@ -417,12 +417,22 @@ class Runtime:
             max_workers=8, thread_name_prefix="rmt-xfer"
         )
         self._xfer_serving: Dict[NodeID, int] = {}  # outbound serves/node
+        self._xfer_served_total: Dict[NodeID, int] = {}  # lifetime serves
+        # broadcast distribution gate: per-oid in-flight pull count +
+        # wakeup when a pull lands (a NEW holder exists to pull from)
+        self._bcast_cond = threading.Condition()
+        self._oid_pulls: Dict[bytes, int] = {}
         import socket as _socket
 
         self._hostname = _socket.gethostname()  # fixed for process life
         self._conn_send_locks: Dict[Any, threading.Lock] = {}
         # lazy p2p transfer servers over LOCAL node stores (node_id -> srv)
         self._xfer_servers: Dict[NodeID, Any] = {}
+        # authenticated transfer connections reused across head-side pulls
+        from .transfer import ConnectionPool
+
+        self._xfer_conn_pool = ConnectionPool(
+            max_idle_per_peer=config.transfer_pool_size)
         self._wakeup_r, self._wakeup_w = os.pipe()
         self._stop = threading.Event()
         self.pg_manager = None  # set by placement_group module on first use
@@ -1305,7 +1315,7 @@ class Runtime:
         message). Cross-node copies run on the transfer pool — the chunked
         push/pull object plane (object_manager.h:114) collapsed to a same-host
         memcpy."""
-        to_fetch: List[Tuple[bytes, NodeID]] = []
+        to_fetch: List[Tuple[bytes, list]] = []
         with self._lock:
             for oid in self._ref_deps(spec):
                 if oid in self.memory_store:
@@ -1317,11 +1327,6 @@ class Runtime:
                 locs = [l for l in locs if l != node_id and
                         self.nodes.get(l) and self.nodes[l].alive]
                 if not locs:
-                    # abandoning this scan: roll back the serve counts
-                    # already taken for earlier deps, or source selection
-                    # would permanently shun those nodes
-                    for _, src in to_fetch:
-                        self._xfer_dec_locked(src)
                     if oid in self._device_locations:
                         # device-resident dep: materialize off the router
                         # thread, then re-place the task
@@ -1334,22 +1339,23 @@ class Runtime:
                         self._recover_then_reschedule, oid, spec, node_id
                     )
                     return False
-                # any holder can serve: pick the location with the fewest
-                # in-flight outbound serves, so a broadcast fans out over
-                # every node that already received a copy instead of
-                # serializing on the original producer (the reference's
-                # object manager likewise pulls from any holder,
-                # object_manager.h:114)
-                to_fetch.append((oid, self._pick_transfer_source(locs)))
+                # hold the CANDIDATE set, not a picked source: the pick
+                # happens inside _transfer_from on the transfer thread,
+                # where the broadcast gate can first wait for an earlier
+                # in-flight copy to land and then pull from the NEW holder
+                # (distribution tree) — a pick taken here, possibly
+                # seconds before the transfer runs, would always name the
+                # original producer
+                to_fetch.append((oid, locs))
         if not to_fetch:
             return True
 
         def do_transfers():
             lost = None
             degraded = []
-            for oid, src in to_fetch:
+            for oid, locs in to_fetch:
                 try:
-                    self._transfer_object(oid, src, node_id)
+                    self._transfer_from(oid, locs, node_id)
                 except Exception as e:  # noqa: BLE001
                     # A failed or backpressured prefetch must never fail
                     # the task while the object is still live somewhere:
@@ -1361,9 +1367,6 @@ class Runtime:
                         degraded.append((oid, e))
                     elif lost is None:
                         lost = (oid, e)
-                finally:
-                    with self._lock:
-                        self._xfer_dec_locked(src)
             if lost is not None:
                 # recovery re-places the task (and fails it only when the
                 # object is unrecoverable)
@@ -1405,22 +1408,84 @@ class Runtime:
 
     def _pick_transfer_source(self, locs) -> NodeID:
         """Least-loaded holder, taking a serve count the caller MUST pair
-        with ``_transfer_from`` (which releases it) — the single source-
-        selection point for every transfer path."""
+        with ``_xfer_dec_locked`` (``_transfer_from`` does) — the single
+        source-selection point for every transfer path."""
         with self._lock:
             src = min(locs, key=lambda l: self._xfer_serving.get(l, 0))
             self._xfer_serving[src] = self._xfer_serving.get(src, 0) + 1
+            self._xfer_served_total[src] = (
+                self._xfer_served_total.get(src, 0) + 1)
         return src
 
+    def _live_holders(self, oid: bytes, dst: NodeID) -> list:
+        """Current live holders of ``oid`` other than ``dst`` — re-read at
+        transfer time so pulls that waited at the broadcast gate see
+        copies that landed while they waited."""
+        return [l for l in self.gcs.get_object_locations(oid)
+                if l != dst and self.nodes.get(l) is not None
+                and self.nodes[l].alive]
+
+    def _broadcast_admit(self, oid: bytes, timeout: float = 15.0) -> None:
+        """Distribution-tree admission for multi-destination pulls of ONE
+        object: at most ``transfer_broadcast_fanout`` concurrent pulls per
+        live holder. Excess pulls WAIT until an in-flight copy lands —
+        each landing registers a new holder in the GCS, raising the cap
+        AND giving the waiter a closer source, so an n-destination
+        broadcast becomes a pipelined tree (O(size·log n) source egress)
+        instead of n serial streams off one node. The gate is advisory:
+        waits are deadline-bounded and a timeout proceeds anyway (worst
+        case is the old source-bottlenecked behavior, never a stall)."""
+        fanout = self.config.transfer_broadcast_fanout
+        if fanout <= 0:
+            return
+        deadline = time.monotonic() + timeout
+        waited = False
+        with self._bcast_cond:
+            while True:
+                holders = max(1, len(self._live_holders(oid, dst=None)))
+                if self._oid_pulls.get(oid, 0) < fanout * holders:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                waited = True
+                self._bcast_cond.wait(min(remaining, 1.0))
+            self._oid_pulls[oid] = self._oid_pulls.get(oid, 0) + 1
+        if waited:
+            try:
+                from . import metrics_defs as mdefs
+
+                mdefs.transfer_broadcast_waits().inc()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _broadcast_release(self, oid: bytes) -> None:
+        with self._bcast_cond:
+            n = self._oid_pulls.get(oid, 1) - 1
+            if n > 0:
+                self._oid_pulls[oid] = n
+            else:
+                self._oid_pulls.pop(oid, None)
+            self._bcast_cond.notify_all()
+
     def _transfer_from(self, oid: bytes, locs, dst: NodeID) -> None:
-        """Pick the best holder among ``locs`` and transfer, keeping the
-        per-node outbound-serve accounting balanced on every exit."""
-        src = self._pick_transfer_source(locs)
+        """Move ``oid`` to ``dst`` from the best CURRENT holder. Admission
+        through the broadcast gate first (late pulls in a fan-out wait for
+        an earlier copy, then pull from the new holder), then a fresh
+        holder read — the passed ``locs`` is only the fallback when the
+        re-read finds nothing (e.g. locations not yet registered). Serve
+        accounting is balanced on every exit."""
+        self._broadcast_admit(oid)
         try:
-            self._transfer_object(oid, src, dst)
+            fresh = self._live_holders(oid, dst)
+            src = self._pick_transfer_source(fresh or locs)
+            try:
+                self._transfer_object(oid, src, dst)
+            finally:
+                with self._lock:
+                    self._xfer_dec_locked(src)
         finally:
-            with self._lock:
-                self._xfer_dec_locked(src)
+            self._broadcast_release(oid)
 
     def _local_transfer_server(self, node_id: NodeID):
         """Lazy TransferServer over a LOCAL node's store, so remote agents
@@ -1432,7 +1497,9 @@ class Runtime:
             if srv is None:
                 srv = TransferServer(
                     self.nodes[node_id].store, self._authkey,
-                    self.config.object_manager_chunk_size)
+                    self.config.object_manager_chunk_size,
+                    max_conns=self.config.transfer_max_conns,
+                    idle_timeout=self.config.transfer_idle_timeout_s)
                 self._xfer_servers[node_id] = srv
         return srv
 
@@ -1484,7 +1551,10 @@ class Runtime:
 
                 err = fetch_object(
                     addr[0], addr[1], self._authkey, oid, dst_nm.store,
-                    self.config.object_manager_chunk_size)
+                    self.config.object_manager_chunk_size,
+                    pool=self._xfer_conn_pool,
+                    stripe_threshold=self.config.transfer_stripe_threshold,
+                    stripe_count=self.config.transfer_stripe_count)
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -2604,8 +2674,12 @@ class Runtime:
             from .transfer import fetch_object
 
             head = self.head_node()
-            err = fetch_object(addr[0], addr[1], self._authkey, oid,
-                               head.store, self.config.object_manager_chunk_size)
+            err = fetch_object(
+                addr[0], addr[1], self._authkey, oid, head.store,
+                self.config.object_manager_chunk_size,
+                pool=self._xfer_conn_pool,
+                stripe_threshold=self.config.transfer_stripe_threshold,
+                stripe_count=self.config.transfer_stripe_count)
             if err is None:
                 self.gcs.add_object_location(oid, head.node_id)
                 local = [head.node_id]
@@ -3325,6 +3399,10 @@ class Runtime:
                     pass
             _SlimFuture.broadcast()
             time.sleep(0.05)
+        try:
+            self._xfer_conn_pool.close()
+        except Exception:
+            pass
         for srv in self._xfer_servers.values():
             try:
                 srv.close()

@@ -1,0 +1,157 @@
+"""Transfer-plane microbenchmarks: the three v2 wire-protocol wins.
+
+Runs at the transfer layer itself — local NodeObjectStores wired through
+real TransferServers over loopback TCP — so the numbers isolate the p2p
+plane (handshake, striping, request loop) from scheduler/worker noise:
+
+  * **small pulls**: p50 latency of a 1 KiB pull with a warm connection
+    pool (handshake amortized) vs a fresh dial + HMAC challenge per pull
+    — the v1 economics, where the handshake dominated metadata-sized
+    payloads.
+  * **striped vs single-stream**: one large object pulled as parallel
+    range requests vs one connection.
+  * **multi-destination chain vs naive**: n destinations pulling the same
+    object off one source (naive: source serves every copy, O(n·size)
+    egress) vs a chain where each destination serves the next (per-source
+    egress stays O(size) regardless of n — the distribution-tree shape
+    runtime.py's broadcast gate produces).
+
+bench.py folds the result into BENCH_DETAIL.json under "transfer";
+tests/test_bench_format.py requires every REQUIRED field.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Dict
+
+
+def run_transfer_microbench(small_pulls: int = 1000,
+                            payload_mb: int = 256,
+                            n_dests: int = 4) -> Dict[str, object]:
+    import os
+
+    from ..config import Config
+    from ..core.object_store import NodeObjectStore
+    from ..core.transfer import (
+        ConnectionPool, TransferServer, fetch_object,
+    )
+
+    capacity = max(64 << 20, (payload_mb << 20) * 2)
+    cfg = Config(object_store_memory=capacity)
+    chunk = cfg.object_manager_chunk_size
+    key = os.urandom(16)
+    tag = os.urandom(3).hex()
+    out: Dict[str, object] = {
+        "small_pulls": small_pulls,
+        "payload_mb": payload_mb,
+        "n_dests": n_dests,
+    }
+
+    src = NodeObjectStore(f"/rmtb_src_{tag}", cfg)
+    dst = NodeObjectStore(f"/rmtb_dst_{tag}", cfg)
+    srv = TransferServer(src, key, chunk,
+                         max_conns=cfg.transfer_max_conns,
+                         idle_timeout=cfg.transfer_idle_timeout_s)
+    pool = ConnectionPool(max_idle_per_peer=cfg.transfer_pool_size)
+    try:
+        # -- small-object pull latency: warm pool vs per-pull handshake ------
+        oid = b"s" * 32
+        src.put_bytes(oid, os.urandom(1024))
+
+        def timed_pulls(n: int, p) -> list:
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                err = fetch_object("127.0.0.1", srv.port, key, oid, dst,
+                                   chunk, pool=p)
+                lat.append((time.perf_counter() - t0) * 1e6)
+                assert err is None, err
+                dst.delete(oid)
+            return lat
+
+        timed_pulls(5, pool)  # warm the pool + fault both stores' pages
+        pooled = timed_pulls(small_pulls, pool)
+        fresh = timed_pulls(small_pulls, None)
+        out["small_pull_p50_us_pooled"] = round(statistics.median(pooled), 1)
+        out["small_pull_p50_us_fresh"] = round(statistics.median(fresh), 1)
+        out["pool_speedup"] = round(
+            out["small_pull_p50_us_fresh"]
+            / max(out["small_pull_p50_us_pooled"], 1e-9), 2)
+        out["pool_hit_rate"] = round(
+            pool.hits / max(pool.hits + pool.misses, 1), 4)
+        src.delete(oid)
+
+        # -- striped vs single-stream large pull ------------------------------
+        big = b"b" * 32
+        src.put_bytes(big, os.urandom(payload_mb << 20))
+        gb = payload_mb / 1024
+        stripes0 = srv.requests_served
+
+        def one_pull(threshold: int) -> float:
+            t0 = time.perf_counter()
+            err = fetch_object("127.0.0.1", srv.port, key, big, dst, chunk,
+                               pool=pool, stripe_threshold=threshold,
+                               stripe_count=cfg.transfer_stripe_count)
+            dt = time.perf_counter() - t0
+            assert err is None, err
+            dst.delete(big)
+            return gb / dt
+
+        one_pull(1 << 40)  # warmup: fault dst pages once, untimed
+        out["single_stream_gbps"] = round(one_pull(1 << 40), 3)
+        out["striped_gbps"] = round(one_pull(cfg.transfer_stripe_threshold),
+                                    3)
+        # stripe requests counted server-side (includes the deferred
+        # size-only request): > stripe_count proves the parallel path ran
+        out["stripe_requests"] = srv.requests_served - stripes0
+        src.delete(big)
+    finally:
+        pool.close()
+        srv.close()
+        dst.close(unlink=True)
+
+    # -- multi-destination distribution: chain vs naive -----------------------
+    payload = os.urandom(min(payload_mb, 64) << 20)
+    oid = b"m" * 32
+    src.put_bytes(oid, payload)
+    stores = [src]
+    servers = [TransferServer(src, key, chunk)]
+    pools = []
+    try:
+        for i in range(n_dests):
+            st = NodeObjectStore(f"/rmtb_d{i}_{tag}", cfg)
+            stores.append(st)
+            servers.append(TransferServer(st, key, chunk))
+
+        def distribute(chained: bool) -> float:
+            p = ConnectionPool(max_idle_per_peer=cfg.transfer_pool_size)
+            pools.append(p)
+            t0 = time.perf_counter()
+            for i in range(1, n_dests + 1):
+                # chain: pull from the PREVIOUS holder; naive: always src
+                source = servers[i - 1] if chained else servers[0]
+                err = fetch_object("127.0.0.1", source.port, key, oid,
+                                   stores[i], chunk, pool=p)
+                assert err is None, err
+            dt = time.perf_counter() - t0
+            for i in range(1, n_dests + 1):
+                stores[i].delete(oid)
+            return (len(payload) / (1 << 30)) * n_dests / dt
+
+        naive0 = servers[0].bytes_served
+        out["naive_gbps"] = round(distribute(chained=False), 3)
+        out["naive_source_bytes"] = servers[0].bytes_served - naive0
+        marks = [s.bytes_served for s in servers]
+        out["broadcast_chain_gbps"] = round(distribute(chained=True), 3)
+        out["chain_max_source_bytes"] = max(
+            s.bytes_served - m for s, m in zip(servers, marks))
+    finally:
+        for p in pools:
+            p.close()
+        for s in servers:
+            s.close()
+        for st in stores:
+            st.close(unlink=True)
+    return out

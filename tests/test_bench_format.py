@@ -142,6 +142,55 @@ def test_tpu_suite_no_tunnel_no_rows_is_loud(bench, tmp_path, monkeypatch):
     assert "error" in out
 
 
+def test_transfer_microbench_reports_required_fields(bench):
+    """The transfer suite must emit every field the BENCH_DETAIL.json
+    contract names (stripe counters, pool hit rate, chain egress) — run a
+    mini-sized pass so CI proves the real code path, not a fixture."""
+    from ray_memory_management_tpu.utils.transfer_bench import (
+        run_transfer_microbench,
+    )
+
+    out = run_transfer_microbench(small_pulls=25, payload_mb=16, n_dests=2)
+    missing = [k for k in bench.REQUIRED_TRANSFER_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["stripe_requests"] >= 1
+    assert 0.0 <= out["pool_hit_rate"] <= 1.0
+    # the distribution-tree egress property, in bytes: naive serves every
+    # copy off one node; the chain caps any single node at ~one copy
+    assert out["naive_source_bytes"] == 2 * out["chain_max_source_bytes"]
+
+
+def test_headline_line_carries_transfer_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    transfer = {"pool_speedup": 3.07, "small_pull_p50_us_pooled": 113.5,
+                "small_pull_p50_us_fresh": 348.0, "pool_hit_rate": 0.99,
+                "naive_source_bytes": 4 << 30,
+                "chain_max_source_bytes": 1 << 30}
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, transfer)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "transfer" in line:  # may be popped only by the <1KB guard
+        assert line["transfer"]["pool_speedup"] == 3.07
+        assert line["transfer"]["egress_flatten"] == 4.0
+
+
+def test_bench_detail_snapshot_has_transfer_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the transfer section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    transfer = detail.get("transfer")
+    assert transfer, "BENCH_DETAIL.json lacks the transfer section"
+    if "error" not in transfer:
+        missing = [k for k in bench.REQUIRED_TRANSFER_FIELDS
+                   if k not in transfer]
+        assert not missing, missing
+
+
 def test_repo_tpu_results_seeded_from_round4_sweep():
     """The repo-root TPU_RESULTS.json carries the round-4 manual sweep so
     a dead tunnel at round end still yields real (stamped) numbers."""

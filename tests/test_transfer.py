@@ -12,7 +12,9 @@ import pytest
 
 from ray_memory_management_tpu.config import Config
 from ray_memory_management_tpu.core.object_store import NodeObjectStore
-from ray_memory_management_tpu.core.transfer import TransferServer, fetch_object
+from ray_memory_management_tpu.core.transfer import (
+    ConnectionPool, TransferServer, fetch_object,
+)
 
 CHUNK = 1 << 20
 
@@ -167,3 +169,175 @@ def test_concurrent_fetches(two_stores):
             assert b.contains(oid)
     finally:
         srv.close()
+
+
+# --- v2 wire protocol: version gate, striping, abort path --------------------
+
+def test_v1_peer_refused_with_mismatch_error(two_stores):
+    """A peer speaking the old protocol gets a loud refusal naming both
+    versions (the strict-equality wire contract), never a mis-parse."""
+    from multiprocessing.connection import Client
+
+    from ray_memory_management_tpu.config import WIRE_PROTOCOL_VERSION
+
+    a, _b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        a.put_bytes(b"V" * 16, b"versioned")
+        conn = Client(("127.0.0.1", srv.port), authkey=key)
+        try:
+            conn.send({"oid": b"V" * 16, "proto": 1})
+            hdr = conn.recv()
+        finally:
+            conn.close()
+        assert "mismatch" in hdr["error"]
+        assert f"v{WIRE_PROTOCOL_VERSION}" in hdr["error"]
+        assert "v1" in hdr["error"]
+    finally:
+        srv.close()
+
+
+def test_striped_fetch_byte_exact(two_stores):
+    """A striped pull must reassemble the exact bytes a single stream
+    delivers — a patterned payload catches any slice misplacement."""
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        payload = np.arange(24 << 18, dtype=np.uint32).tobytes()  # 24 MiB
+        a.put_bytes(b"S" * 16, payload)
+        before = srv.requests_served
+        err = fetch_object("127.0.0.1", srv.port, key, b"S" * 16, b, CHUNK,
+                           stripe_threshold=8 << 20, stripe_count=4)
+        assert err is None
+        # deferred size request + 4 range requests prove the striped path
+        # (server counters tick just AFTER the client's last recv: wait)
+        import time
+        deadline = time.monotonic() + 5.0
+        while (srv.requests_served - before < 5
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.requests_served - before >= 5
+        view = b.get(b"S" * 16)
+        assert bytes(view) == payload
+        del view
+        b.release(b"S" * 16)
+    finally:
+        srv.close()
+
+
+def test_mid_stripe_failure_aborts_unsealed(two_stores, monkeypatch):
+    """A connection dying mid-stripe must abort the whole fetch and leave
+    NO sealed truncated object; an unpatched retry then succeeds."""
+    from ray_memory_management_tpu.core import transfer as tr
+
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    try:
+        payload = np.arange(24 << 18, dtype=np.uint32).tobytes()
+        a.put_bytes(b"K" * 16, payload)
+        real = tr._recv_exact
+        calls = {"n": 0}
+
+        def killed(conn, sub):
+            calls["n"] += 1
+            if calls["n"] == 2:  # second stripe dies mid-payload
+                raise OSError("connection killed mid-stripe")
+            return real(conn, sub)
+
+        monkeypatch.setattr(tr, "_recv_exact", killed)
+        err = fetch_object("127.0.0.1", srv.port, key, b"K" * 16, b, CHUNK,
+                           stripe_threshold=8 << 20, stripe_count=4)
+        assert err is not None
+        assert not b.contains(b"K" * 16)  # aborted, never sealed truncated
+
+        monkeypatch.setattr(tr, "_recv_exact", real)
+        err = fetch_object("127.0.0.1", srv.port, key, b"K" * 16, b, CHUNK,
+                           stripe_threshold=8 << 20, stripe_count=4)
+        assert err is None
+        view = b.get(b"K" * 16)
+        assert bytes(view) == payload
+        del view
+        b.release(b"K" * 16)
+    finally:
+        srv.close()
+
+
+# --- connection-pool lifecycle ------------------------------------------------
+
+def test_pool_reuses_connection_across_pulls(two_stores):
+    """Sequential pooled pulls ride ONE authenticated connection: the
+    server accepts once, the pool records a hit on the second pull."""
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    pool = ConnectionPool()
+    try:
+        for i in (1, 2):
+            oid = bytes([i]) * 16
+            a.put_bytes(oid, bytes([i]) * 4096)
+            err = fetch_object("127.0.0.1", srv.port, key, oid, b, CHUNK,
+                               pool=pool)
+            assert err is None and b.contains(oid)
+        assert srv.connections_accepted == 1
+        assert pool.hits == 1 and pool.misses == 1
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_pool_evicts_stale_conn_on_server_restart(two_stores):
+    """A pooled connection to a RESTARTED server is stale: the next pull
+    must detect the dead stream, discard it, and transparently retry on a
+    fresh dial — not hard-fail."""
+    a, b = two_stores
+    key = os.urandom(16)
+    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+    pool = ConnectionPool()
+    try:
+        a.put_bytes(b"P" * 16, b"first")
+        err = fetch_object("127.0.0.1", srv.port, key, b"P" * 16, b, CHUNK,
+                           pool=pool)
+        assert err is None
+        b.delete(b"P" * 16)
+        port = srv.port
+        srv.close()  # pooled conn is now stale
+        # Listener sets SO_REUSEADDR on posix: rebind the same port
+        srv = TransferServer(a, authkey=key, chunk_size=CHUNK,
+                             bind_port=port)
+        err = fetch_object("127.0.0.1", port, key, b"P" * 16, b, CHUNK,
+                           pool=pool)
+        assert err is None and b.contains(b"P" * 16)
+        assert pool.hits == 1  # the stale conn WAS handed out, then evicted
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_create_or_wait_wakes_on_seal(two_stores):
+    """A fetch racing an in-flight copy must resolve via the store's
+    change condition (microseconds after the seal), not a poll tick."""
+    import time
+
+    from ray_memory_management_tpu.core.transfer import create_or_wait
+
+    a, _b = two_stores
+    oid = b"W" * 16
+    buf = a.create(oid, 64)  # unsealed in-flight copy
+
+    def seal_soon():
+        time.sleep(0.2)
+        buf[:] = b"x" * 64
+        a.seal(oid)
+
+    t = threading.Thread(target=seal_soon)
+    t.start()
+    t0 = time.perf_counter()
+    got, err = create_or_wait(a, oid, 64, timeout=10.0)
+    waited = time.perf_counter() - t0
+    t.join()
+    assert got is None and err is None  # racing copy became readable
+    assert 0.15 < waited < 2.0  # woke promptly, didn't burn the timeout
+    del buf
