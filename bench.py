@@ -376,7 +376,20 @@ def _metrics_snapshot() -> dict:
                 total = sum(m.series().values())
                 if total:
                     counters[m.info["name"]] = round(total, 1)
+        # fault/retry/failover counters always present (zero-filled): a
+        # bench run on a healthy cluster SHOWS it took zero retries, and
+        # a chaos bench shows exactly what the recovery machinery did
+        from ray_memory_management_tpu.core import metrics_defs as mdefs
+
+        fault_plane = {}
+        for acc in ("faults_injected", "retry_attempts", "retry_exhausted",
+                    "transfer_failovers", "transfer_checksum_mismatch",
+                    "transfer_auth_failures", "spill_errors",
+                    "spill_degraded", "stale_creates_aborted"):
+            m = getattr(mdefs, acc)()
+            fault_plane[m.info["name"]] = round(sum(m.series().values()), 1)
         return {"task_counters": counters,
+                "fault_plane": fault_plane,
                 "task_latencies": state.summarize_task_latencies()}
     except Exception as e:  # pragma: no cover - keep the headline alive
         return {"error": repr(e)}

@@ -128,6 +128,43 @@ _flag("gcs_storage_path", str, "",
       "redis_store_client.h:28).")
 
 # --- fault tolerance ---------------------------------------------------------
+_flag("fault_injection_spec", str, "",
+      "Deterministic fault-injection plane spec (utils/faults.py): "
+      "';'-separated 'site:mode[:p=P][:after=N][:max=N][:stall=S]' rules "
+      "over the registered sites (transfer.send/recv/dial, spill.write/"
+      "read, control.dispatch, worker.exec). Empty disables injection. "
+      "Propagates to node agents and workers via RMT_fault_injection_spec.")
+_flag("fault_injection_seed", int, 0,
+      "Seed for the fault plane's per-site RNG streams: same seed + spec "
+      "=> the same injection schedule, replayable across runs.")
+_flag("transfer_retry_attempts", int, 3,
+      "Max attempts per transfer-plane operation (dial, fetch) under the "
+      "unified RetryPolicy before the failure is surfaced.")
+_flag("transfer_retry_backoff_s", float, 0.05,
+      "Base exponential backoff between transfer retries (jittered).")
+_flag("transfer_stripe_deadline_s", float, 30.0,
+      "Per-stripe progress deadline on a striped pull: a stripe that "
+      "stalls past this re-resolves live holders and re-pulls its range "
+      "from an alternate source (mid-pull holder failover) instead of "
+      "hanging the whole fetch.")
+_flag("transfer_verify_checksum", bool, True,
+      "Verify the CRC32 carried in transfer replies / spill metadata at "
+      "every materialization boundary (stripe completion, restore). A "
+      "mismatch is treated as object loss — re-pull or reconstruct — "
+      "never silent corruption.")
+_flag("spill_retry_attempts", int, 3,
+      "Max attempts per spill/restore IO operation under the RetryPolicy.")
+_flag("spill_retry_backoff_s", float, 0.1,
+      "Base exponential backoff between spill IO retries (jittered).")
+_flag("spill_degraded_backoff_s", float, 30.0,
+      "After spill IO exhausts its retries, the store degrades to keeping "
+      "objects in memory under backpressure (loud SPILL_DEGRADED event, "
+      "not a crash) and re-probes the storage backend at this period.")
+_flag("unsealed_create_deadline_s", float, 300.0,
+      "Unsealed creates older than this are swept and aborted (the "
+      "fetching process died mid-pull and leaked the allocation). Must "
+      "comfortably exceed every bounded transfer timeout so a live "
+      "in-flight pull is never swept out from under its writer.")
 _flag("num_heartbeats_timeout", int, 30,
       "Missed heartbeats before a node is declared dead "
       "(gcs_heartbeat_manager.cc:29).")

@@ -25,6 +25,18 @@ class ExternalStorage:
     def delete(self, url: str) -> None:
         raise NotImplementedError
 
+    def probe(self) -> bool:
+        """Write-and-delete a tiny sentinel object; True when the backend
+        is usable. The store's spill-degraded mode calls this to decide
+        when to resume spilling after persistent IO failure (a flaky
+        volume that recovered, a bucket whose credentials were fixed)."""
+        try:
+            url = self.spill(b"\x00" * 8 + b"rmtprobe", memoryview(b"ok"))
+            self.delete(url)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
 
 class FileSystemStorage(ExternalStorage):
     """One file per spilled object under ``directory`` (reference :243; the

@@ -253,6 +253,25 @@ class GCS:
         with self._lock:
             return set(self.object_locations.get(oid, ()))
 
+    def prune_location(self, oid: bytes, node_id: NodeID) -> None:
+        """Drop a directory entry a fetch proved STALE (the holder said
+        "object not in store"): distinct from remove_object_location so
+        the repair is visible — counted and evented — because a directory
+        that keeps lying re-routes every retry back to the same empty
+        holder."""
+        self.remove_object_location(oid, node_id)
+        try:
+            from ..utils import events
+            from . import metrics_defs as mdefs
+
+            mdefs.object_directory_prunes().inc()
+            events.emit("OBJECT_LOCATION_PRUNED",
+                        f"pruned stale holder {node_id[:8] if isinstance(node_id, str) else node_id} "
+                        f"of {oid.hex()[:12]} from the object directory",
+                        source="gcs")
+        except Exception:  # noqa: BLE001
+            pass
+
     def take_objects_locations(self, oids) -> Dict[bytes, Set[NodeID]]:
         """Batch pop: every listed object's location set, removed from
         the directory, ONE lock acquisition. The free path over a

@@ -84,6 +84,45 @@ DEFS: Dict[str, tuple] = {
         description="Multi-destination pulls that waited at the broadcast "
                     "gate for an earlier copy to land (then pulled from a "
                     "new holder instead of the original source).")),
+    # fault plane / recovery (the robustness PR's instrument set: every
+    # injected fault, retry, failover and degradation is countable, so a
+    # recovery regression shows in /metrics, not just tail latency)
+    "rmt_faults_injected_total": (Counter, dict(
+        description="Faults injected by the deterministic fault plane "
+                    "(utils/faults.py), by site and mode.",
+        tag_keys=("site", "mode"))),
+    "rmt_retry_attempts_total": (Counter, dict(
+        description="Retries taken under the unified RetryPolicy, by "
+                    "plane (transfer, transfer.dial, push, spill, ...).",
+        tag_keys=("plane",))),
+    "rmt_retry_exhausted_total": (Counter, dict(
+        description="RetryPolicy budgets spent without success, by plane.",
+        tag_keys=("plane",))),
+    "rmt_transfer_failovers_total": (Counter, dict(
+        description="Mid-pull holder failovers: stripe ranges re-pulled "
+                    "from an alternate holder after the original stalled "
+                    "or died (no lineage re-execution).")),
+    "rmt_transfer_checksum_mismatch_total": (Counter, dict(
+        description="Payload CRC32 mismatches detected at a "
+                    "materialization boundary (stripe completion, "
+                    "restore) — treated as object loss, never returned.")),
+    "rmt_transfer_auth_failures_total": (Counter, dict(
+        description="Transfer dials refused at the authentication "
+                    "handshake (non-retryable, distinct from peer death).")),
+    "rmt_spill_errors_total": (Counter, dict(
+        description="Spill-storage IO errors (before retry), by op.",
+        tag_keys=("op",))),
+    "rmt_spill_degraded_total": (Counter, dict(
+        description="Times the store entered spill-degraded mode "
+                    "(persistent storage failure; objects stay in memory "
+                    "under backpressure until a probe succeeds).")),
+    "rmt_stale_creates_aborted_total": (Counter, dict(
+        description="Unsealed creates swept and aborted after exceeding "
+                    "unsealed_create_deadline_s (leaked by a dead "
+                    "fetcher).")),
+    "rmt_object_directory_prunes_total": (Counter, dict(
+        description="Stale GCS object-directory locations pruned after a "
+                    "holder reported the object missing.")),
     # collectives
     "rmt_collective_latency_seconds": (Histogram, dict(
         description="Wall time per collective op.",
@@ -191,6 +230,46 @@ def transfer_pool_misses() -> Counter:
 
 def transfer_broadcast_waits() -> Counter:
     return get("rmt_transfer_broadcast_waits_total")
+
+
+def faults_injected() -> Counter:
+    return get("rmt_faults_injected_total")
+
+
+def retry_attempts() -> Counter:
+    return get("rmt_retry_attempts_total")
+
+
+def retry_exhausted() -> Counter:
+    return get("rmt_retry_exhausted_total")
+
+
+def transfer_failovers() -> Counter:
+    return get("rmt_transfer_failovers_total")
+
+
+def transfer_checksum_mismatch() -> Counter:
+    return get("rmt_transfer_checksum_mismatch_total")
+
+
+def transfer_auth_failures() -> Counter:
+    return get("rmt_transfer_auth_failures_total")
+
+
+def spill_errors() -> Counter:
+    return get("rmt_spill_errors_total")
+
+
+def spill_degraded() -> Counter:
+    return get("rmt_spill_degraded_total")
+
+
+def stale_creates_aborted() -> Counter:
+    return get("rmt_stale_creates_aborted_total")
+
+
+def object_directory_prunes() -> Counter:
+    return get("rmt_object_directory_prunes_total")
 
 
 def collective_latency_seconds() -> Histogram:

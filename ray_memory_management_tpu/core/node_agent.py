@@ -50,6 +50,8 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from ..config import Config
+from ..utils import faults
+from ..utils.retry import RetryPolicy
 from .object_store import NodeObjectStore
 
 
@@ -102,6 +104,9 @@ class NodeAgent:
         self.node_id: bytes = hello["node_id"]
         self.config = Config(**hello["config"])
         self.inline_limit = self.config.max_direct_call_object_size
+        # adopt the cluster's fault-injection plane (same seed/spec the
+        # head exported) so a chaos run is replayable across every host
+        faults.configure_from(self.config)
 
         _reap_stale_agent_stores()
         self.store_name = f"/rmtA_{os.getpid()}_{os.urandom(4).hex()}"
@@ -335,6 +340,12 @@ class NodeAgent:
                 self.store.sweep_pins()  # expire obj_ensure residency pins
             except Exception:
                 pass
+            try:
+                # abort creates left unsealed past the deadline (a peer
+                # that died mid-push leaks the reservation otherwise)
+                self.store.sweep_unsealed()
+            except Exception:
+                pass
             with self._lock:
                 dead = [(wid, p) for wid, p in self._worker_procs.items()
                         if p.poll() is not None]
@@ -485,6 +496,9 @@ class NodeAgent:
         host = msg["host"] or self._head_ip
         port, oid, req = msg["port"], msg["oid"], msg["req"]
         src_store = msg.get("src_store")
+        # alternate live holders (head-resolved) for mid-pull failover;
+        # host "" means the head itself, as with the primary source
+        alts = [(h or self._head_ip, p) for h, p in msg.get("alts") or ()]
 
         def run():
             err = None
@@ -497,7 +511,14 @@ class NodeAgent:
                         self.config.object_manager_chunk_size,
                         pool=self._xfer_conn_pool,
                         stripe_threshold=self.config.transfer_stripe_threshold,
-                        stripe_count=self.config.transfer_stripe_count)
+                        stripe_count=self.config.transfer_stripe_count,
+                        alt_sources=(lambda: alts) if alts else None,
+                        retry=RetryPolicy(
+                            max_attempts=self.config.transfer_retry_attempts,
+                            base_backoff_s=self.config.transfer_retry_backoff_s,
+                            plane="transfer"),
+                        verify_checksum=self.config.transfer_verify_checksum,
+                        stripe_deadline=self.config.transfer_stripe_deadline_s)
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
             try:

@@ -410,7 +410,28 @@ class NodeManager:
 
     # -- dispatch -------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> None:
+        # fault plane, control side: an injected dispatch failure models
+        # a dropped/late control frame; the runtime's dispatch RetryPolicy
+        # (_submit_to_node) is what recovers it
+        from ..utils import faults
+
+        act = faults.fire("control.dispatch")
+        if act is not None:
+            if act.mode == "stall":
+                act.sleep()
+            else:
+                act.raise_()
         with self._lock:
+            if not self.alive:
+                # a dead node's queue is drained exactly once by its
+                # death handler; accepting a spec here would wedge it
+                # forever ("not retryable" on THIS node — the dispatcher
+                # re-places it on a live one)
+                from ..exceptions import NodeDeadError
+
+                raise NodeDeadError(
+                    f"node {self.node_id.hex()[:12]} is dead "
+                    "(not retryable)")
             self.queue.append(spec)
 
     def backlog(self) -> int:

@@ -31,6 +31,9 @@ from ..exceptions import ObjectStoreFullError
 from ..native import ShmStore, ShmStoreFullError
 from . import external_storage as ext
 from ..serialization import SerializedObject
+from ..utils import faults
+from ..utils.integrity import crc32
+from ..utils.retry import RetryExhausted, RetryPolicy
 
 
 class NodeObjectStore:
@@ -68,6 +71,20 @@ class NodeObjectStore:
             max_workers=self.config.max_io_workers,
             thread_name_prefix=f"io-{name.strip('/')}",
         )
+        # lazy full-object CRC32 cache (NOT computed at seal: an eager crc
+        # would serialize a full extra pass onto the put path, halving put
+        # bandwidth; the first transfer/spill that needs it pays it once)
+        self._crc: Dict[bytes, int] = {}
+        # crc recorded at spill-write time, verified at restore — a worn
+        # spill volume corrupting at rest is a detected loss, not poison
+        self._spill_crc: Dict[bytes, int] = {}
+        # unsealed creates by start time: a fetcher that dies mid-pull
+        # leaks its allocation until restart without sweep_unsealed()
+        self._unsealed: Dict[bytes, float] = {}
+        # 0.0 = spilling healthy; else monotonic time before which spill
+        # IO is suspended (degraded mode: objects stay in memory under
+        # backpressure; a probe decides recovery)
+        self._spill_degraded_until = 0.0
 
     def _notify_object_change(self) -> None:
         with self._change_cond:
@@ -84,12 +101,14 @@ class NodeObjectStore:
     def put_serialized(self, object_id: bytes, serialized: SerializedObject) -> None:
         buf = self._create_with_spill(object_id, serialized.total_size)
         serialized.write_into(buf)
+        self._unsealed.pop(object_id, None)
         self.shm.seal(object_id)
         self._notify_object_change()
 
     def put_bytes(self, object_id: bytes, data) -> None:
         buf = self._create_with_spill(object_id, len(data))
         buf[:] = data
+        self._unsealed.pop(object_id, None)
         self.shm.seal(object_id)
         self._notify_object_change()
 
@@ -102,8 +121,39 @@ class NodeObjectStore:
         return self._create_with_spill(object_id, size, timeout_s)
 
     def seal(self, object_id: bytes) -> None:
+        self._unsealed.pop(object_id, None)
         self.shm.seal(object_id)
         self._notify_object_change()
+
+    def checksum(self, object_id: bytes) -> Optional[int]:
+        """Full-object CRC32, computed lazily and cached until delete.
+        Served in transfer replies so pullers can verify end to end; None
+        when the object is absent. Lazy (first serve/spill pays it, not
+        the put path) because an eager crc at seal would add a full
+        serial pass to every put — measured at ~half the put-path
+        bandwidth for large objects."""
+        c = self._crc.get(object_id)
+        if c is not None:
+            return c
+        view = self.shm.get(object_id)
+        if view is not None:
+            try:
+                c = crc32(view)
+            finally:
+                del view
+                self.shm.release(object_id)
+        else:
+            with self._spill_lock:
+                c = self._spill_crc.get(object_id)
+                url = self._spilled.get(object_id)
+            if c is None and url is not None:
+                try:
+                    c = crc32(self._storage.restore(object_id, url))
+                except Exception:  # noqa: BLE001 — concurrently deleted
+                    return None
+        if c is not None:
+            self._crc[object_id] = c
+        return c
 
     def _create_with_spill(self, object_id: bytes, size: int,
                            timeout_s: Optional[float] = None) -> memoryview:
@@ -125,7 +175,9 @@ class NodeObjectStore:
         pin_break_at = time.monotonic() + min(0.5, timeout_s / 2)
         while True:
             try:
-                return self.shm.create(object_id, size)
+                buf = self.shm.create(object_id, size)
+                self._unsealed[object_id] = time.monotonic()
+                return buf
             except ShmStoreFullError:
                 pass
             if time.monotonic() >= deadline:
@@ -149,10 +201,92 @@ class NodeObjectStore:
             self.shm.release(oid)
         return bool(victims)
 
+    def _spill_allowed(self) -> bool:
+        """False while spill IO is suspended (degraded mode). Once the
+        backoff window lapses, a probe write decides recovery: success
+        resumes spilling loudly, failure re-arms the window."""
+        if self._spill_degraded_until == 0.0:
+            return True
+        if time.monotonic() < self._spill_degraded_until:
+            return False
+        if self._storage.probe():
+            self._spill_degraded_until = 0.0
+            from ..utils import events
+
+            events.emit("SPILL_RECOVERED",
+                        f"store {self.name}: spill storage probe "
+                        "succeeded, resuming spilling",
+                        source="object_store")
+            return True
+        self._spill_degraded_until = (
+            time.monotonic() + self.config.spill_degraded_backoff_s)
+        return False
+
+    def _enter_spill_degraded(self, err: BaseException) -> None:
+        """Persistent spill failure: degrade to keeping objects in memory
+        under backpressure — a LOUD event and counter, never a crash. New
+        allocations now wait on reader refs / pins and eventually raise
+        ObjectStoreFullError when truly full, which is the correct
+        pressure signal for the caller's retry."""
+        self._spill_degraded_until = (
+            time.monotonic() + self.config.spill_degraded_backoff_s)
+        from ..utils import events
+        from . import metrics_defs as mdefs
+
+        events.emit("SPILL_DEGRADED",
+                    f"store {self.name}: spill storage failing "
+                    f"persistently ({err!r}); keeping objects in memory "
+                    f"under backpressure, re-probing in "
+                    f"{self.config.spill_degraded_backoff_s:.0f}s",
+                    severity=events.ERROR, source="object_store")
+        mdefs.spill_degraded().inc()
+
+    def _spill_io(self, object_id: bytes, view: memoryview) -> str:
+        """One object's spill write under the unified RetryPolicy, with
+        the ``spill.write`` fault site and a crc recorded for restore-time
+        verification. Runs on an IO thread."""
+        want = self._crc.get(object_id)
+        if want is None:
+            want = crc32(view)
+            self._crc[object_id] = want
+
+        def once() -> str:
+            try:
+                act = faults.fire("spill.write")
+                if act is not None:
+                    if act.mode == "stall":
+                        act.sleep()
+                    elif act.mode in ("error", "drop"):
+                        act.raise_()
+                url = self._storage.spill(object_id, view)
+                if act is not None and act.mode == "corrupt":
+                    # overwrite the spill copy with a flipped byte — the
+                    # in-memory object is NEVER touched; only the
+                    # restore-time crc can catch this
+                    url = self._storage.spill(
+                        object_id,
+                        memoryview(faults.corrupt_bytes(view)))
+                return url
+            except Exception:
+                from . import metrics_defs as mdefs
+
+                mdefs.spill_errors().inc(tags={"op": "write"})
+                raise
+
+        policy = RetryPolicy(
+            max_attempts=self.config.spill_retry_attempts,
+            base_backoff_s=self.config.spill_retry_backoff_s,
+            plane="spill")
+        url = policy.run(once)
+        self._spill_crc[object_id] = want
+        return url
+
     def _spill_for(self, need_bytes: int) -> int:
         """Spill at least ``need_bytes`` of LRU unreferenced objects; returns
         bytes freed."""
         with self._spill_lock:
+            if not self._spill_allowed():
+                return 0
             candidates = self.shm.evict_candidates(need_bytes)
             freed = 0
             n_spilled = 0
@@ -164,12 +298,13 @@ class NodeObjectStore:
                     continue
                 views[oid] = view
                 futures.append((oid, self._io.submit(
-                    self._storage.spill, oid, view)))
+                    self._spill_io, oid, view)))
             for oid, fut in futures:
                 try:
                     url = fut.result()
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — retries exhausted
                     self.shm.release(oid)
+                    self._enter_spill_degraded(e)
                     continue
                 self._spilled[oid] = url
                 view = views.pop(oid)
@@ -291,18 +426,66 @@ class NodeObjectStore:
                     self._restoring.pop(object_id, None)
                 ev.set()
 
+    def _spill_read(self, object_id: bytes, url: str) -> bytes:
+        """One object's restore read under the unified RetryPolicy, with
+        the ``spill.read`` fault site and crc verification against the
+        spill-time checksum. A mismatch that survives retries propagates
+        as loss (RetryExhausted) — corrupted bytes are NEVER returned."""
+
+        def once() -> bytes:
+            try:
+                act = faults.fire("spill.read")
+                if act is not None:
+                    if act.mode == "stall":
+                        act.sleep()
+                    elif act.mode in ("error", "drop"):
+                        act.raise_()
+                data = self._storage.restore(object_id, url)
+                if act is not None and act.mode == "corrupt":
+                    data = faults.corrupt_bytes(data)
+                want = self._spill_crc.get(object_id)
+                if want is not None \
+                        and self.config.transfer_verify_checksum \
+                        and crc32(data) != want:
+                    from . import metrics_defs as mdefs
+
+                    mdefs.spill_errors().inc(tags={"op": "checksum"})
+                    raise OSError(
+                        f"spill payload checksum mismatch restoring "
+                        f"{object_id.hex()[:12]} from {url}")
+                return data
+            except FileNotFoundError:
+                raise  # concurrent delete, not an IO failure
+            except Exception:
+                from . import metrics_defs as mdefs
+
+                mdefs.spill_errors().inc(tags={"op": "read"})
+                raise
+
+        from ..utils.retry import is_retryable_error
+
+        policy = RetryPolicy(
+            max_attempts=self.config.spill_retry_attempts,
+            base_backoff_s=self.config.spill_retry_backoff_s,
+            plane="spill",
+            retryable=lambda e: (not isinstance(e, FileNotFoundError)
+                                 and is_retryable_error(e)))
+        return policy.run(once)
+
     def _restore_into_shm(self, object_id: bytes) -> Optional[memoryview]:
         """Move one spilled object back into shm; returns a referenced view
-        (or None if it was deleted concurrently). Caller holds the
-        _restoring claim for this object."""
+        (or None if it was deleted concurrently, or the spill copy proved
+        unreadable/corrupt — the caller treats that as object loss and
+        re-fetches/reconstructs). Caller holds the _restoring claim for
+        this object."""
         with self._spill_lock:
             url = self._spilled.get(object_id)
         if url is None:
             return self.shm.get(object_id)
         try:
-            data = self._storage.restore(object_id, url)
-        except OSError:
-            return None  # concurrently delete()d
+            data = self._spill_read(object_id, url)
+        except (OSError, RetryExhausted):
+            return None  # concurrently delete()d, or unrecoverable IO
         try:
             buf = self._create_with_spill(object_id, len(data))
         except ValueError:
@@ -315,9 +498,11 @@ class NodeObjectStore:
         # object sealed-with-zero-refs (it would evict it and the pop
         # below would erase the NEW spill record — losing the object)
         with self._spill_lock:
+            self._unsealed.pop(object_id, None)
             self.shm.seal(object_id)
             out = self.shm.get(object_id)
             self._spilled.pop(object_id, None)
+            self._spill_crc.pop(object_id, None)
         # synchronous: a delete queued on the _io pool would be dropped by
         # close()'s shutdown(wait=False), orphaning the spill file
         self._storage.delete(url)
@@ -344,9 +529,9 @@ class NodeObjectStore:
             if url is None:
                 continue
             try:
-                return self._storage.restore(object_id, url)
-            except OSError:
-                continue  # restored or delete()d concurrently
+                return self._spill_read(object_id, url)
+            except (OSError, RetryExhausted):
+                continue  # restored or delete()d concurrently, or lost
         return None
 
     def contains(self, object_id: bytes) -> bool:
@@ -359,6 +544,9 @@ class NodeObjectStore:
         with self._spill_lock:
             url = self._spilled.pop(object_id, None)
             pin = self._pinned.pop(object_id, None)
+            self._spill_crc.pop(object_id, None)
+        self._crc.pop(object_id, None)
+        self._unsealed.pop(object_id, None)
         if pin is not None:
             view, _ = pin
             del view
@@ -368,11 +556,61 @@ class NodeObjectStore:
         self.shm.delete(object_id)
         self._notify_object_change()
 
+    def sweep_unsealed(self, deadline_s: Optional[float] = None) -> int:
+        """Abort unsealed creates older than ``deadline_s`` (default:
+        config unsealed_create_deadline_s) and return how many. A fetch
+        whose process died mid-pull leaks its allocation forever
+        otherwise — arena bytes no allocation can reclaim until restart.
+        Called from the owner heartbeat / agent reap loops.
+
+        The deadline MUST exceed every bounded transfer timeout (default
+        300s vs the ~120s fetch budget): aborting a create a live fetch
+        is still streaming into would hand its arena bytes to another
+        allocation mid-write. Only creates made through THIS
+        NodeObjectStore are tracked (a StoreClient in another process
+        seals its own creates synchronously)."""
+        if deadline_s is None:
+            deadline_s = self.config.unsealed_create_deadline_s
+        now = time.monotonic()
+        stale = [oid for oid, t in list(self._unsealed.items())
+                 if now - t > deadline_s]
+        aborted = 0
+        for oid in stale:
+            if self._unsealed.pop(oid, None) is None:
+                continue  # sealed/deleted while we looked
+            view = self.shm.get(oid)
+            if view is not None:
+                # actually sealed (a pop we missed): never abort real data
+                del view
+                self.shm.release(oid)
+                continue
+            try:
+                if self.shm.delete(oid):  # aborts the unsealed create
+                    aborted += 1
+            except Exception:  # noqa: BLE001
+                pass
+        if aborted:
+            from ..utils import events
+            from . import metrics_defs as mdefs
+
+            events.emit("STALE_CREATE_ABORTED",
+                        f"store {self.name}: aborted {aborted} unsealed "
+                        f"create(s) older than {deadline_s:.0f}s",
+                        severity=events.WARNING, source="object_store",
+                        count=aborted)
+            mdefs.stale_creates_aborted().inc(aborted)
+            self._notify_object_change()
+        return aborted
+
     def usage(self):
         return self.shm.usage()
 
     def spilled_count(self) -> int:
         return len(self._spilled)
+
+    def spill_degraded(self) -> bool:
+        """True while spill IO is suspended after persistent failure."""
+        return self._spill_degraded_until != 0.0
 
     def close(self, unlink: bool = False) -> None:
         self._io.shutdown(wait=False)

@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional
 
 from ..config import Config
 from ..ids import NodeID, WorkerID
+from ..utils.retry import RetryPolicy
 from .node_manager import NodeManager, WorkerHandle
 from .resources import NodeResources
 
@@ -215,18 +216,21 @@ class RemoteNodeManager(NodeManager):
         here with backoff for up to ``push_pressure_retry_s``: the
         caller holds a read ref on the source copy the whole time, so
         pressure delays the transfer but can never lose the object."""
-        backoff = 0.2
-        deadline = time.monotonic() + self.config.push_pressure_retry_s
+        policy = RetryPolicy(
+            max_attempts=10_000,  # bounded by the deadline, not attempts
+            base_backoff_s=0.2, max_backoff_s=1.0,
+            deadline_s=self.config.push_pressure_retry_s,
+            retryable=lambda e: "retryable" in str(e), plane="push")
+        attempt = 0
         while True:
             ok, err = self._push_object_once(object_id, view, timeout)
             if ok or not self.alive:
                 return ok, err
-            if not (err and "retryable" in err):
+            if not policy.is_retryable(err or ""):
                 return False, err
-            if time.monotonic() >= deadline:
+            if not policy.backoff(attempt):
                 return False, err
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 1.0)
+            attempt += 1
 
     def _push_object_once(self, object_id: bytes, view: memoryview,
                           timeout: float):
@@ -302,13 +306,16 @@ class RemoteNodeManager(NodeManager):
 
     def fetch_from_peer(self, oid: bytes, host: str, port: int,
                         timeout: float = 120.0,
-                        src_store: Optional[str] = None) -> Optional[str]:
+                        src_store: Optional[str] = None,
+                        alts: Optional[list] = None) -> Optional[str]:
         """Tell the agent to pull ``oid`` straight from a peer's transfer
         server (host "" = the head). ``src_store`` names the source's shm
         segment when the peer shares the agent's host — the agent then
-        maps it and memcpys instead of speaking TCP. Returns None on
-        success, else an error string. Payload bytes never touch the head
-        or this channel."""
+        maps it and memcpys instead of speaking TCP. ``alts`` lists other
+        live holders' transfer addresses (head-resolved) so the agent can
+        fail a stalled pull over mid-stripe. Returns None on success,
+        else an error string. Payload bytes never touch the head or this
+        channel."""
         if not self.alive:
             return "node dead"
         req = self._new_req()
@@ -316,6 +323,8 @@ class RemoteNodeManager(NodeManager):
                "port": port, "req": req}
         if src_store:
             msg["src_store"] = src_store
+        if alts:
+            msg["alts"] = list(alts)
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None or not self.channel_send(msg):

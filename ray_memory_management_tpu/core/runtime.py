@@ -34,6 +34,7 @@ from ..config import Config
 from ..exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    NodeDeadError,
     ObjectLostError,
     ObjectStoreFullError,
     TaskError,
@@ -433,6 +434,12 @@ class Runtime:
 
         self._xfer_conn_pool = ConnectionPool(
             max_idle_per_peer=config.transfer_pool_size)
+        # install the deterministic fault plane (no-op without a spec);
+        # configure_from also exports RMT_fault_injection_* so spawned
+        # agents/zygotes/workers replay the same schedule
+        from ..utils import faults as _faults
+
+        _faults.configure_from(config)
         self._wakeup_r, self._wakeup_w = os.pipe()
         self._stop = threading.Event()
         self.pg_manager = None  # set by placement_group module on first use
@@ -550,9 +557,15 @@ class Runtime:
             if hasattr(nm, "mark_dead"):  # remote: wake pending transfers
                 nm.mark_dead()
             self.gcs.mark_node_dead(node_id)
+            workers = list(nm.workers.values())
+        # snapshot AFTER alive=False, under the node's own lock: a submit
+        # racing this drain either lands before it (captured here) or
+        # sees the dead flag and raises NodeDeadError (re-placed by
+        # _submit_to_node). Without the ordering, a late submit wedges
+        # the spec on a queue nobody drains again.
+        with nm._lock:
             requeue = list(nm.queue)
             nm.queue.clear()
-            workers = list(nm.workers.values())
         for h in workers:
             try:
                 h.proc.terminate()
@@ -796,9 +809,13 @@ class Runtime:
                 return
             nm.mark_dead()
             self.gcs.mark_node_dead(nm.node_id)
+            workers = list(nm.workers.values())
+        # same drain ordering as remove_node: dead flag first, then the
+        # queue snapshot under the node's lock, so a racing submit can
+        # never land a spec behind the one-and-only drain
+        with nm._lock:
             requeue = list(nm.queue)
             nm.queue.clear()
-            workers = list(nm.workers.values())
         for h in workers:
             self._on_worker_death(h)
         for spec in requeue:
@@ -1286,13 +1303,30 @@ class Runtime:
                 return
         self._place_on_node(spec, node_id, pump=pump)
 
+    def _submit_to_node(self, node_id: NodeID, spec: TaskSpec) -> None:
+        """Hand one spec to a node's dispatch queue under the dispatch
+        RetryPolicy: a transient control.dispatch failure (the injectable
+        fault site in NodeManager.submit) is retried with backoff instead
+        of failing a task the cluster could still run."""
+        from ..utils.retry import RetryPolicy
+
+        try:
+            RetryPolicy(max_attempts=3, base_backoff_s=0.02,
+                        plane="dispatch").run(
+                self.nodes[node_id].submit, spec)
+        except NodeDeadError:
+            # the node died between placement and hand-off (e.g. while
+            # this task's args were still in transfer) — re-place on a
+            # live node instead of wedging on a queue nobody drains
+            self._schedule(spec)
+
     def _place_on_node(self, spec: TaskSpec, node_id: NodeID,
                        pump: bool = True) -> None:
         nm = self.nodes[node_id]
         if not self._ensure_args_local(spec, node_id):
             return  # transfer in flight; re-placed when it completes
         had_backlog = bool(nm.queue)
-        nm.submit(spec)
+        self._submit_to_node(node_id, spec)
         with self._lock:
             rec = self.tasks.get(spec.task_id)
             if rec:
@@ -1380,7 +1414,7 @@ class Runtime:
                     f"{degraded[0][1]!r}); worker will fetch inline",
                     severity=events.WARNING, source="object_manager")
             try:
-                self.nodes[node_id].submit(spec)
+                self._submit_to_node(node_id, spec)
                 self._wakeup()
             except Exception as e:  # noqa: BLE001
                 self._fail_task(spec, TaskError(spec.name, e))
@@ -1424,6 +1458,50 @@ class Runtime:
         return [l for l in self.gcs.get_object_locations(oid)
                 if l != dst and self.nodes.get(l) is not None
                 and self.nodes[l].alive]
+
+    def _holder_addrs(self, oid: bytes) -> list:
+        """Transfer-plane (host, port) addresses of the CURRENT live
+        holders of ``oid`` — the alt-source resolver a fetch re-invokes
+        at each failover, so holders that died mid-pull are excluded and
+        copies that landed since are found. Head-local holders serve via
+        their lazy local TransferServer ("" host = loopback for the
+        head; agents receive their head_ip substitution in _obj_fetch)."""
+        out = []
+        for l in self.gcs.get_object_locations(oid):
+            nm = self.nodes.get(l)
+            if nm is None or not nm.alive:
+                continue
+            addr = getattr(nm, "transfer_addr", None)
+            if addr is not None:
+                out.append((addr[0], addr[1]))
+            elif getattr(nm, "store", None) is not None:
+                try:
+                    out.append(("", self._local_transfer_server(l).port))
+                except Exception:  # noqa: BLE001
+                    pass
+        return out
+
+    def _fetch_policy(self):
+        """The head-side transfer RetryPolicy from config knobs."""
+        from ..utils.retry import RetryPolicy
+
+        return RetryPolicy(
+            max_attempts=self.config.transfer_retry_attempts,
+            base_backoff_s=self.config.transfer_retry_backoff_s,
+            plane="transfer")
+
+    def _prune_stale_location(self, oid: bytes, node_id: NodeID,
+                              err: Optional[str]) -> None:
+        """Drop a GCS object-directory location that a fetch proved stale
+        ("object not in store"): the directory said the holder had it, the
+        holder disagreed — leaving the entry would re-route every retry
+        and failover back to the same empty holder."""
+        if not err or "object not in store" not in err:
+            return
+        try:
+            self.gcs.prune_location(oid, node_id)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _broadcast_admit(self, oid: bytes, timeout: float = 15.0) -> None:
         """Distribution-tree admission for multi-destination pulls of ONE
@@ -1533,7 +1611,8 @@ class Runtime:
                              if dst_nm.hostname == self._hostname else None)
             if addr is not None:
                 err = dst_nm.fetch_from_peer(oid, addr[0], addr[1],
-                                             src_store=src_store)
+                                             src_store=src_store,
+                                             alts=self._holder_addrs(oid))
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -1554,10 +1633,15 @@ class Runtime:
                     self.config.object_manager_chunk_size,
                     pool=self._xfer_conn_pool,
                     stripe_threshold=self.config.transfer_stripe_threshold,
-                    stripe_count=self.config.transfer_stripe_count)
+                    stripe_count=self.config.transfer_stripe_count,
+                    alt_sources=lambda: self._holder_addrs(oid),
+                    retry=self._fetch_policy(),
+                    verify_checksum=self.config.transfer_verify_checksum,
+                    stripe_deadline=self.config.transfer_stripe_deadline_s)
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
+                self._prune_stale_location(oid, src, err)
                 events.emit(
                     "TRANSFER_FALLBACK",
                     f"p2p fetch of {oid.hex()[:8]} failed ({err}); "
@@ -2342,6 +2426,12 @@ class Runtime:
                             sweep()  # expire ensure_resident pins
                         except Exception:
                             pass
+                    gc = getattr(nm.store, "sweep_unsealed", None)
+                    if gc is not None:
+                        try:
+                            gc()  # abort creates leaked by dead fetchers
+                        except Exception:
+                            pass
             # reap workers that died WITHOUT ever dialing in (killed by
             # remove_node mid-spawn, import crash, OOM at startup): no
             # pipe means no EOF, so without this sweep their dedicated
@@ -2679,11 +2769,16 @@ class Runtime:
                 self.config.object_manager_chunk_size,
                 pool=self._xfer_conn_pool,
                 stripe_threshold=self.config.transfer_stripe_threshold,
-                stripe_count=self.config.transfer_stripe_count)
+                stripe_count=self.config.transfer_stripe_count,
+                alt_sources=lambda: self._holder_addrs(oid),
+                retry=self._fetch_policy(),
+                verify_checksum=self.config.transfer_verify_checksum,
+                stripe_deadline=self.config.transfer_stripe_deadline_s)
             if err is None:
                 self.gcs.add_object_location(oid, head.node_id)
                 local = [head.node_id]
                 break
+            self._prune_stale_location(oid, node_id, err)
         for node_id in local + remote:
             nm = self.nodes.get(node_id)
             if nm is None or not nm.alive:
@@ -3347,6 +3442,15 @@ class Runtime:
         self._stop.set()
         try:
             self.gcs.set_job_state(self.job_id.binary(), "FINISHED")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            # a config-installed fault plane is scoped to THIS cluster:
+            # drop it and its env exports so a later init (or any child
+            # spawned after) doesn't inherit the chaos
+            from ..utils import faults
+
+            faults.deconfigure()
         except Exception:  # noqa: BLE001
             pass
         self._sender_pool.stop()

@@ -37,6 +37,10 @@ both versions):
     server -> client   {"size": <span>, "total": <nbytes>}      (payload)
                   or   {"size": <nbytes>, "deferred": true}     (no payload)
                   or   {"error": <str>}
+    ...full-object replies also carry "crc" (CRC32 of the whole payload,
+    additive optional key — still protocol v2) when the serving store can
+    produce it; clients verify at stripe completion / stream end and
+    treat a mismatch as object loss (re-pull), never silent corruption.
     server -> client   raw chunk frames until ``size`` bytes are sent
     ...the connection then awaits the next request (idle timeout applies).
 
@@ -56,9 +60,17 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import faults
+from ..utils.integrity import crc32, crc32_combine
+from ..utils.retry import RetryPolicy
 
 _CONNECT_TIMEOUT = 20.0
+# per-stripe progress deadline default (config: transfer_stripe_deadline_s):
+# a stripe whose socket makes no progress for this long is declared dead
+# and its range re-pulled from an alternate holder
+_DEFAULT_STRIPE_DEADLINE = 30.0
 # module defaults used when a caller passes no explicit striping config
 # (unit-level callers); runtime/node_agent call sites pass their scoped
 # Config values explicitly
@@ -89,6 +101,19 @@ def _count(metric_accessor: str, n: int = 1) -> None:
         getattr(mdefs, metric_accessor)().inc(n)
     except Exception:  # noqa: BLE001
         pass
+
+
+def _store_crc(store, oid: bytes) -> Optional[int]:
+    """Full-object CRC32 from the serving store's lazy checksum cache
+    (NodeObjectStore.checksum); None when the store has no cache or the
+    object vanished. Never fails the serve path."""
+    fn = getattr(store, "checksum", None)
+    if fn is None:
+        return None
+    try:
+        return fn(oid)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _set_io_timeout(fd: int, seconds: float) -> None:
@@ -260,6 +285,21 @@ class TransferServer:
                 f"v{WIRE_PROTOCOL_VERSION}, peer spoke "
                 f"v{req.get('proto')}")})
             return False
+        # fault plane, serve side: drop vanishes mid-request (peer sees
+        # EOF), stall delays the reply past the client's stripe deadline,
+        # error answers with a refusal, corrupt flips a payload byte on
+        # the wire (the store's copy is NEVER touched)
+        act = faults.fire("transfer.send")
+        if act is not None:
+            if act.mode == "stall":
+                act.sleep()
+            elif act.mode == "error":
+                conn.send({"error": (
+                    f"injected error at transfer.send (#{act.seq})")})
+                return True
+            elif act.mode == "drop":
+                return False
+        corrupt = act is not None and act.mode == "corrupt"
         oid = req["oid"]
         view = self.store.read(oid)
         if view is None:
@@ -272,8 +312,14 @@ class TransferServer:
             defer_above = req.get("defer_above")
             if length is None and defer_above is not None and n > defer_above:
                 # size-only answer: the client allocates once, then fans
-                # the payload out as parallel range requests
-                conn.send({"size": n, "deferred": True})
+                # the payload out as parallel range requests. The full-
+                # object crc rides here so the client can verify the
+                # combined stripes against it.
+                reply = {"size": n, "deferred": True}
+                c = _store_crc(self.store, oid)
+                if c is not None:
+                    reply["crc"] = c
+                conn.send(reply)
                 self.requests_served += 1
                 return True
             span = (n - offset) if length is None else int(length)
@@ -283,12 +329,20 @@ class TransferServer:
                     f"{n}-byte object")})
                 return True
             t0 = time.monotonic()
-            conn.send({"size": span, "total": n})
+            reply = {"size": span, "total": n}
+            if offset == 0 and span == n:
+                c = _store_crc(self.store, oid)
+                if c is not None:
+                    reply["crc"] = c
+            conn.send(reply)
             mv = memoryview(view)
             try:
                 for off in range(offset, offset + span, self.chunk_size):
                     end = min(off + self.chunk_size, offset + span)
-                    conn.send_bytes(mv[off:end])
+                    if corrupt and off == offset:
+                        conn.send_bytes(faults.corrupt_bytes(mv[off:end]))
+                    else:
+                        conn.send_bytes(mv[off:end])
             finally:
                 mv.release()
             self.requests_served += 1
@@ -327,21 +381,36 @@ class TransferServer:
                 pass
 
 
-def _dial(host: str, port: int, authkey: bytes, timeout: float):
+def _dial(host: str, port: int, authkey: bytes, timeout: float,
+          retry: Optional[RetryPolicy] = None):
     """Dial a TransferServer and run the handshake. Returns (conn, None)
-    or (None, error_string). The connect/handshake phase retries ONCE:
+    or (None, error_string). The connect/handshake phase retries under
+    the unified RetryPolicy (default: 2 attempts, the pre-policy budget):
     nothing has streamed yet, and on a saturated host a GIL-starved peer
     can miss even a generous handshake budget (observed: a full-suite
-    teardown starving an 8-way fetch's challenge past 30s)."""
+    teardown starving an 8-way fetch's challenge past 30s).
+
+    An authentication refusal returns a DISTINCT error string
+    ("authentication failed ...") that retry loops classify as permanent
+    — a wrong key is indistinguishable from peer death under the old
+    generic "connect ... failed" message — and bumps its own counter."""
     from multiprocessing import AuthenticationError
     from multiprocessing.connection import (
         Connection, answer_challenge, deliver_challenge,
     )
 
-    last_exc: Optional[BaseException] = None
-    for _attempt in range(2):
+    policy = retry if retry is not None else RetryPolicy(
+        max_attempts=2, base_backoff_s=0.05, plane="transfer.dial")
+    attempt = 0
+    while True:
         conn = None
         try:
+            act = faults.fire("transfer.dial")
+            if act is not None:
+                if act.mode == "stall":
+                    act.sleep()
+                else:  # drop / error / corrupt: the dial just fails
+                    act.raise_()
             sock = socket.create_connection((host, port),
                                             timeout=_CONNECT_TIMEOUT)
             sock.settimeout(None)  # timeouts via SO_RCVTIMEO below
@@ -355,15 +424,19 @@ def _dial(host: str, port: int, authkey: bytes, timeout: float):
             deliver_challenge(conn, authkey)
             return conn, None
         except Exception as e:  # noqa: BLE001 — peer down / auth refused
-            last_exc = e
             if conn is not None:
                 try:
                     conn.close()
                 except OSError:
                     pass
             if isinstance(e, AuthenticationError):
-                break  # a wrong key will not become right on retry
-    return None, f"connect to {host}:{port} failed: {last_exc!r}"
+                # a wrong key will not become right on retry
+                _count("transfer_auth_failures")
+                return None, (f"authentication failed dialing "
+                              f"{host}:{port}: {e!r}")
+            if not policy.backoff(attempt):
+                return None, f"connect to {host}:{port} failed: {e!r}"
+            attempt += 1
 
 
 class ConnectionPool:
@@ -475,11 +548,27 @@ def create_or_wait(dst_store, oid: bytes, size: int, timeout: float = 30.0):
 def _recv_exact(conn, sub) -> None:
     """Stream exactly ``sub.nbytes`` into the (shm) view ``sub``; the
     per-operation socket timeout bounds every recv. Split out so tests
-    can fault-inject a mid-stripe connection kill."""
+    can fault-inject a mid-stripe connection kill.
+
+    Fault plane, receive side: drop kills this connection under the
+    in-flight stream (the next recv sees EOF), stall delays past the
+    stripe deadline, error raises mid-receive, corrupt flips a byte in
+    the landed buffer AFTER the stream (what a bad DIMM/NIC on the
+    receive path does — only the checksum can catch it)."""
+    act = faults.fire("transfer.recv")
+    if act is not None:
+        if act.mode == "stall":
+            act.sleep()
+        elif act.mode == "error":
+            act.raise_()
+        elif act.mode == "drop":
+            _shutdown_fd(conn.fileno())
     size = sub.nbytes
     got = 0
     while got < size:
         got += conn.recv_bytes_into(sub[got:])
+    if act is not None and act.mode == "corrupt" and size:
+        sub[0:1] = bytes([sub[0] ^ 0xFF])
 
 
 def _request_range(conn, oid: bytes, offset: int, length: int, sub,
@@ -518,7 +607,11 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                  timeout: float = 120.0,
                  pool: Optional[ConnectionPool] = None,
                  stripe_threshold: Optional[int] = None,
-                 stripe_count: Optional[int] = None) -> Optional[str]:
+                 stripe_count: Optional[int] = None,
+                 alt_sources: Optional[Callable] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 verify_checksum: bool = True,
+                 stripe_deadline: Optional[float] = None) -> Optional[str]:
     """Pull one object from a peer's TransferServer straight into
     ``dst_store``. Returns None on success, an error string on failure.
 
@@ -527,8 +620,28 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
     anywhere, which is what keeps a GB-scale transfer O(chunk) in memory
     on both ends. Objects at or above ``stripe_threshold`` are fetched as
     ``stripe_count`` parallel range requests into disjoint slices of that
-    one allocation, sealed once after all stripes land; any stripe
-    failure aborts the unsealed create so a retry can re-allocate.
+    one allocation, sealed once after all stripes land.
+
+    Failure handling, innermost to outermost:
+
+      * A failed/stalled STRIPE (socket silent past ``stripe_deadline``)
+        re-pulls just its range from an alternate holder resolved via
+        ``alt_sources`` into the same unsealed create — mid-pull holder
+        failover, no lineage re-execution, no re-transfer of the ranges
+        that already landed.
+      * A payload whose CRC32 disagrees with the serving store's ("crc"
+        in the reply) is aborted and counted, never sealed — the outer
+        loop re-pulls it.
+      * The whole fetch retries under ``retry`` (unified RetryPolicy;
+        default 3 attempts with jittered backoff), rotating across
+        ``alt_sources()`` so a dead source is abandoned, not hammered.
+        Non-retryable failures (authentication, protocol mismatch)
+        surface immediately.
+
+    ``alt_sources``: zero-arg callable returning the CURRENT live holder
+    list as (host, port) tuples — typically a closure over the GCS object
+    directory, re-invoked at each failover so holders that died since the
+    fetch began are excluded and new copies are found.
 
     ``pool``: a ConnectionPool amortizes the dial + challenge handshake
     across pulls (and serves stripe connections). Without one, every
@@ -541,6 +654,45 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
     fails the fetch instead of hanging the calling thread (and, on an
     agent, instead of pinning the oid unsealed forever, which would block
     the head's push fallback)."""
+    policy = retry if retry is not None else RetryPolicy(
+        max_attempts=3, base_backoff_s=0.05, plane="transfer")
+    sources: List[Tuple[str, int]] = [(host, port)]
+    attempt = 0
+    while True:
+        h, p = sources[attempt % len(sources)]
+        err = _fetch_once(h, p, authkey, oid, dst_store, chunk_size,
+                          timeout, pool, stripe_threshold, stripe_count,
+                          alt_sources, verify_checksum, stripe_deadline)
+        if err is None:
+            return None
+        if not policy.is_retryable(err):
+            return err
+        if alt_sources is not None:
+            # rotate to the CURRENT holder set, preferring anything that
+            # is not the source that just failed
+            try:
+                alts = [tuple(s) for s in (alt_sources() or [])]
+            except Exception:  # noqa: BLE001
+                alts = []
+            if alts:
+                rest = [s for s in alts if s != (h, p)]
+                sources = rest or alts
+        if not policy.backoff(attempt):
+            return err
+        attempt += 1
+
+
+def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
+                dst_store, chunk_size: int, timeout: float,
+                pool: Optional[ConnectionPool],
+                stripe_threshold: Optional[int],
+                stripe_count: Optional[int],
+                alt_sources: Optional[Callable],
+                verify_checksum: bool,
+                stripe_deadline: Optional[float]) -> Optional[str]:
+    """One fetch attempt from one source (the pre-policy fetch_object
+    body). Returns None on success, an error string on failure; never
+    leaves an unsealed create behind."""
     from ..config import WIRE_PROTOCOL_VERSION
 
     if stripe_threshold is None:
@@ -575,6 +727,10 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
         if conn is None:
             return err
         try:
+            # re-arm the per-operation timeout: a pooled connection keeps
+            # whatever (possibly stripe-deadline-short) timeout its last
+            # user set
+            _set_io_timeout(conn.fileno(), min(timeout, 30.0))
             conn.send({"oid": oid, "proto": WIRE_PROTOCOL_VERSION,
                        "defer_above": stripe_threshold})
             hdr = conn.recv()
@@ -595,6 +751,7 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
             conn = None
             return err
         size = hdr["size"]
+        expect_crc = hdr.get("crc")
         buf, race_err = create_or_wait(dst_store, oid, size,
                                        timeout=min(timeout, 30.0))
         if not hdr.get("deferred"):
@@ -607,6 +764,12 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                 return race_err
             try:
                 _recv_exact(conn, buf)
+                if verify_checksum and expect_crc is not None \
+                        and crc32(buf) != expect_crc:
+                    _count("transfer_checksum_mismatch")
+                    raise _ChecksumMismatch(
+                        f"payload checksum mismatch pulling "
+                        f"{oid.hex()[:12]} from {host}:{port}")
             except BaseException:
                 # abort the unsealed create so retries can re-allocate.
                 # delete() handles unsealed entries directly (obj_delete
@@ -634,7 +797,15 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
         first_conn, conn = conn, None  # ownership moves to the striped path
         return _striped_fetch(host, port, authkey, oid, dst_store, buf,
                               size, stripe_count, first_conn, pool,
-                              _release, timeout, t0)
+                              _release, timeout, t0,
+                              alt_sources=alt_sources,
+                              expect_crc=expect_crc,
+                              verify_checksum=verify_checksum,
+                              stripe_deadline=stripe_deadline)
+    except _ChecksumMismatch as e:
+        # the stream was fully consumed before the verify — the
+        # connection stays usable, but the payload is poison
+        return str(e)
     except (EOFError, OSError) as e:
         return f"transfer from {host}:{port} failed: {e!r}"
     except Exception as e:  # noqa: BLE001 — store full after wait, etc.
@@ -644,35 +815,65 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
             ConnectionPool.discard(conn)
 
 
+class _ChecksumMismatch(Exception):
+    """Internal: a fully-received payload failed its CRC verify."""
+
+
 def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
                    dst_store, buf, total: int, stripe_count: int,
                    first_conn, pool: Optional[ConnectionPool], _release,
-                   timeout: float, t0: float) -> Optional[str]:
+                   timeout: float, t0: float,
+                   alt_sources: Optional[Callable] = None,
+                   expect_crc: Optional[int] = None,
+                   verify_checksum: bool = True,
+                   stripe_deadline: Optional[float] = None
+                   ) -> Optional[str]:
     """Fan ``total`` bytes out as parallel range requests into disjoint
     slices of ``buf`` (the already-created, unsealed allocation).
     ``first_conn`` carries stripe 0; each other stripe acquires its own
     connection (pooled when available). Owns ``buf``: seals on success,
-    aborts the create on any failure."""
+    aborts the create on any failure.
+
+    A stripe that errors or stalls past ``stripe_deadline`` does NOT
+    abort the fetch: its missing range is re-pulled from the alternate
+    holders ``alt_sources()`` resolves at that moment — into the same
+    unsealed allocation, leaving the stripes that already landed in
+    place. Each stripe's CRC is computed in its own thread (overlapped
+    with the other stripes' socket reads) and combined via
+    ``crc32_combine`` against the serving store's full-object crc."""
     from ..config import WIRE_PROTOCOL_VERSION
 
+    if stripe_deadline is None or stripe_deadline <= 0:
+        stripe_deadline = _DEFAULT_STRIPE_DEADLINE
     ranges = _stripe_ranges(total, stripe_count)
+    crcs: Dict[int, int] = {}  # offset -> crc32 of that landed range
     errors: List[str] = []
-    err_mu = threading.Lock()
+    mu = threading.Lock()
 
-    def pull_range(offset: int, span: int, conn, release_fn) -> None:
+    def pull_range(offset: int, span: int, conn, release_fn,
+                   src: Tuple[str, int]) -> bool:
         sub = buf[offset:offset + span]
         try:
+            # the per-stripe progress deadline: silence on this socket
+            # past it means the holder is stalled/dead — fail the stripe
+            # (NOT the fetch) so its range can fail over
+            _set_io_timeout(conn.fileno(),
+                            min(stripe_deadline, timeout))
             _request_range(conn, oid, offset, span, sub,
                            WIRE_PROTOCOL_VERSION)
+            c = crc32(sub) if verify_checksum else 0
         except BaseException as e:  # noqa: BLE001
             ConnectionPool.discard(conn)
-            with err_mu:
+            with mu:
                 errors.append(f"stripe [{offset}, {offset + span}) from "
-                              f"{host}:{port} failed: {e!r}")
-            return
+                              f"{src[0]}:{src[1]} failed: {e!r}")
+            return False
         finally:
             sub.release()
+        with mu:
+            crcs[offset] = c
         release_fn(conn)
+        return True
 
     def pull_range_fresh(offset: int, span: int) -> None:
         if pool is not None:
@@ -680,10 +881,10 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
         else:
             conn, err = _dial(host, port, authkey, timeout)
         if conn is None:
-            with err_mu:
+            with mu:
                 errors.append(err)
             return
-        pull_range(offset, span, conn, _release)
+        pull_range(offset, span, conn, _release, (host, port))
 
     threads = []
     for offset, span in ranges[1:]:
@@ -691,19 +892,71 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
                              daemon=True, name="xfer-stripe")
         t.start()
         threads.append(t)
-    pull_range(ranges[0][0], ranges[0][1], first_conn, _release)
+    pull_range(ranges[0][0], ranges[0][1], first_conn, _release,
+               (host, port))
     for t in threads:
         t.join()
 
-    if errors:
-        # all stripe threads are done (their subviews released): abort
-        # the unsealed create so a retry can re-allocate
+    missing = [(o, s) for (o, s) in ranges if o not in crcs]
+    if missing and alt_sources is not None:
+        # mid-pull holder failover: re-resolve LIVE holders and re-pull
+        # only the missing ranges into the same unsealed create — the
+        # landed stripes are kept, nothing re-runs lineage
+        try:
+            alts = [tuple(s) for s in (alt_sources() or [])]
+        except Exception:  # noqa: BLE001
+            alts = []
+        alts = [s for s in alts if s != (host, port)]
+        for offset, span in missing:
+            for ah, ap in alts:
+                if pool is not None:
+                    conn, _pooled, err = pool.acquire(ah, ap, authkey,
+                                                      timeout)
+                else:
+                    conn, err = _dial(ah, ap, authkey, timeout)
+                if conn is None:
+                    with mu:
+                        errors.append(err)
+                    continue
+
+                def rel(c, _h=ah, _p=ap):
+                    if pool is not None:
+                        pool.release(_h, _p, authkey, c)
+                    else:
+                        try:
+                            c.close()
+                        except OSError:
+                            pass
+
+                if pull_range(offset, span, conn, rel, (ah, ap)):
+                    _count("transfer_failovers")
+                    break
+        missing = [(o, s) for (o, s) in ranges if o not in crcs]
+
+    if missing:
+        # unrecoverable: abort the unsealed create (all stripe threads
+        # are done, their subviews released) so a retry can re-allocate
         del buf
         try:
             dst_store.delete(oid)
         except Exception:  # noqa: BLE001
             pass
-        return errors[0]
+        return errors[0] if errors else (
+            f"striped pull of {oid.hex()[:12]} left ranges {missing}")
+
+    if verify_checksum and expect_crc is not None:
+        combined = 0
+        for offset, span in ranges:
+            combined = crc32_combine(combined, crcs[offset], span)
+        if combined != expect_crc:
+            _count("transfer_checksum_mismatch")
+            del buf
+            try:
+                dst_store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            return (f"payload checksum mismatch pulling "
+                    f"{oid.hex()[:12]} from {host}:{port} (striped)")
     dst_store.seal(oid)
     _count("transfer_striped_fetches")
     _observe_transfer("pull", total, time.monotonic() - t0)

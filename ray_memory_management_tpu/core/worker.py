@@ -36,6 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from .. import serialization as ser
+from ..utils import faults
 from .object_store import StoreClient
 
 # Actor classes preloaded by the ZYGOTE before forking (zygote.serve):
@@ -695,6 +696,14 @@ class Worker:
         try:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
+            # fault site: an injected error rides the normal app-error
+            # path, so recovery is the task-retry machinery itself
+            act = faults.fire("worker.exec")
+            if act is not None:
+                if act.mode == "stall":
+                    act.sleep()
+                else:
+                    act.raise_()
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             env = msg.get("runtime_env")
             if env:

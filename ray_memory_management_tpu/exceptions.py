@@ -53,6 +53,13 @@ class ObjectStoreFullError(RmtError):
     """Store full and spilling could not make room (ObjectStoreFullError)."""
 
 
+class NodeDeadError(RmtError):
+    """A task or transfer was handed to a node already marked dead. The
+    operation is not retryable ON THIS NODE — the caller must re-place
+    it on a live one (the dead node's queue is drained exactly once by
+    its death handler and never again)."""
+
+
 class GetTimeoutError(RmtError, TimeoutError):
     """``get(timeout=...)`` expired (python/ray/exceptions.py GetTimeoutError)."""
 

@@ -1,0 +1,171 @@
+"""One retry/backoff policy for every data-plane hop.
+
+Before this module, each plane carried its own ad-hoc budget: ``_dial``
+hardcoded two attempts, pressured pushes looped on
+``push_pressure_retry_s`` with inline backoff math, p2p fetches retried
+once on a stale pooled connection, and spill IO never retried at all.
+Podracer-style pod runtimes survive preemption-heavy fleets because
+every hop has a deadline, a bounded retry budget, and a single
+classification of what is worth retrying (arxiv 2104.06272); this is
+that policy object, with per-plane attempt / exhaustion counters
+(``rmt_retry_attempts_total{plane}`` / ``rmt_retry_exhausted_total``)
+so a recovery regression is visible in /metrics, not just in tail
+latency.
+
+Usage — loop style (callers that get error strings back)::
+
+    pol = RetryPolicy(max_attempts=3, base_backoff_s=0.05, plane="transfer")
+    attempt = 0
+    while True:
+        err = try_once()
+        if err is None:
+            return None
+        if not pol.is_retryable(err) or not pol.backoff(attempt):
+            return err          # classified permanent, or budget exhausted
+        attempt += 1
+
+or call style (callers that raise)::
+
+    data = RetryPolicy(plane="spill").run(lambda: storage.restore(oid, url))
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+# substrings marking an error permanent: retrying cannot change the
+# outcome, and the retry loop must fail fast instead of burning its
+# budget (the _dial AuthenticationError lesson: a generic "connect
+# failed" string made auth refusals indistinguishable from peer death)
+_NON_RETRYABLE_MARKERS = (
+    "authentication failed",
+    "wire protocol mismatch",
+    "not retryable",
+    "unsupported",
+)
+
+
+def _count(accessor: str, tags=None, n: int = 1) -> None:
+    """Bump a metrics_defs counter; instrumentation never fails a retry
+    loop."""
+    try:
+        from ..core import metrics_defs as mdefs
+
+        getattr(mdefs, accessor)().inc(n, tags=tags)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def is_retryable_error(err) -> bool:
+    """Default classification shared by every plane. ``err`` is an error
+    string or an exception. Permanent: authentication refusals, wire
+    protocol mismatches, anything explicitly marked not-retryable, and
+    programming errors (TypeError/KeyError). Everything else — peer
+    death, timeouts, full stores, IO errors — is worth another attempt."""
+    if err is None:
+        return False
+    if isinstance(err, BaseException):
+        from multiprocessing import AuthenticationError
+
+        if isinstance(err, AuthenticationError):
+            return False
+        if isinstance(err, (TypeError, KeyError, AttributeError)):
+            return False
+        err = str(err)
+    low = str(err).lower()
+    return not any(m in low for m in _NON_RETRYABLE_MARKERS)
+
+
+class RetryExhausted(Exception):
+    """Raised by ``run`` when the budget is spent; carries the last
+    underlying error as ``__cause__``."""
+
+
+class RetryPolicy:
+    """Deadline + max attempts + exponential backoff with jitter +
+    retryable-error classification, with per-plane counters.
+
+    ``plane`` tags the counters ("transfer", "transfer.dial", "push",
+    "spill", "dispatch"); ``retryable`` overrides the default
+    classification; ``rng`` makes the jitter deterministic in tests."""
+
+    def __init__(self, *, max_attempts: int = 3,
+                 base_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 deadline_s: Optional[float] = None,
+                 jitter: float = 0.25,
+                 plane: str = "",
+                 retryable: Optional[Callable] = None,
+                 rng: Optional[random.Random] = None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.deadline_s = deadline_s
+        self.jitter = jitter
+        self.plane = plane
+        self._retryable = retryable or is_retryable_error
+        self._rng = rng or random
+        self._started_at: Optional[float] = None
+
+    # -- budget ---------------------------------------------------------------
+    def _deadline(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        if self._started_at is None:
+            self._started_at = time.monotonic()
+        return self._started_at + self.deadline_s
+
+    def is_retryable(self, err) -> bool:
+        return self._retryable(err)
+
+    def backoff_delay(self, attempt: int) -> float:
+        """The sleep before retry ``attempt`` (0-based): exponential from
+        ``base_backoff_s`` capped at ``max_backoff_s``, plus up to
+        ``jitter`` fraction of itself so a fleet of retriers never
+        thunders in phase."""
+        d = min(self.base_backoff_s * (2 ** attempt), self.max_backoff_s)
+        return d * (1.0 + self.jitter * self._rng.random())
+
+    def backoff(self, attempt: int) -> bool:
+        """Account one failed attempt and sleep the backoff. Returns False
+        — bumping the exhaustion counter — when the budget (attempts or
+        deadline) is spent and the caller must give up."""
+        deadline = self._deadline()
+        if attempt + 1 >= self.max_attempts:
+            self.note_exhausted()
+            return False
+        delay = self.backoff_delay(attempt)
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.note_exhausted()
+                return False
+            delay = min(delay, remaining)
+        _count("retry_attempts", tags={"plane": self.plane})
+        if delay > 0:
+            time.sleep(delay)
+        return True
+
+    def note_exhausted(self) -> None:
+        _count("retry_exhausted", tags={"plane": self.plane})
+
+    # -- call style -----------------------------------------------------------
+    def run(self, fn: Callable, *args, **kwargs):
+        """Call ``fn`` under this policy: retryable exceptions back off
+        and re-call; a non-retryable exception re-raises immediately; a
+        spent budget raises :class:`RetryExhausted` from the last error."""
+        self._started_at = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self.is_retryable(e):
+                    raise
+                if not self.backoff(attempt):
+                    raise RetryExhausted(
+                        f"{self.plane or 'operation'} failed after "
+                        f"{attempt + 1} attempt(s): {e!r}") from e
+                attempt += 1

@@ -228,9 +228,11 @@ def test_striped_fetch_byte_exact(two_stores):
 
 
 def test_mid_stripe_failure_aborts_unsealed(two_stores, monkeypatch):
-    """A connection dying mid-stripe must abort the whole fetch and leave
-    NO sealed truncated object; an unpatched retry then succeeds."""
+    """A connection dying mid-stripe must abort the whole fetch (under a
+    single-attempt policy, no failover source) and leave NO sealed
+    truncated object; an unpatched retry then succeeds."""
     from ray_memory_management_tpu.core import transfer as tr
+    from ray_memory_management_tpu.utils.retry import RetryPolicy
 
     a, b = two_stores
     key = os.urandom(16)
@@ -249,7 +251,8 @@ def test_mid_stripe_failure_aborts_unsealed(two_stores, monkeypatch):
 
         monkeypatch.setattr(tr, "_recv_exact", killed)
         err = fetch_object("127.0.0.1", srv.port, key, b"K" * 16, b, CHUNK,
-                           stripe_threshold=8 << 20, stripe_count=4)
+                           stripe_threshold=8 << 20, stripe_count=4,
+                           retry=RetryPolicy(max_attempts=1))
         assert err is not None
         assert not b.contains(b"K" * 16)  # aborted, never sealed truncated
 
