@@ -306,6 +306,51 @@ def _transfer_suite():
         return {"error": repr(e)}
 
 
+# locality-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): the scheduling win —
+# tasks/s and bytes moved with the locality score on vs off, plus the
+# prestage-overlap proof for forced non-holder placements.
+REQUIRED_LOCALITY_FIELDS = (
+    "locality_on_tasks_per_s", "locality_off_tasks_per_s",
+    "locality_speedup", "bytes_moved_on_mb", "bytes_moved_off_mb",
+    "locality_hits", "locality_misses", "locality_bytes_avoided_mb",
+    "prefetch_started", "prefetch_completed", "prefetch_overlap_ms",
+    "n_nodes", "n_tasks", "arg_mb",
+)
+
+
+def _locality_suite():
+    """Locality scheduling + argument prestage (utils/locality_bench.py);
+    fault-isolated so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.locality_bench import (
+            run_locality_suite,
+        )
+
+        out = run_locality_suite()
+        print(
+            f"  locality fan-out ({out['n_tasks']} tasks x "
+            f"{out['arg_mb']} MB args, {out['n_nodes']} nodes): "
+            f"{out['locality_on_tasks_per_s']:.0f} tasks/s on vs "
+            f"{out['locality_off_tasks_per_s']:.0f} off "
+            f"({out['locality_speedup']:.2f}x), moved "
+            f"{out['bytes_moved_on_mb']:.0f} MB vs "
+            f"{out['bytes_moved_off_mb']:.0f} MB", file=sys.stderr)
+        print(
+            f"  locality avoided {out['locality_bytes_avoided_mb']:.0f} MB "
+            f"({out['locality_hits']} hits / {out['locality_misses']} "
+            f"misses); prestage {out['prefetch_completed']}/"
+            f"{out['prefetch_started']} landed, overlap "
+            f"{out['prefetch_overlap_ms']:.1f} ms", file=sys.stderr)
+        missing = [k for k in REQUIRED_LOCALITY_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  locality suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -423,6 +468,7 @@ def main() -> None:
         rmt.shutdown()
 
     transfer = _transfer_suite()
+    locality = _locality_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
 
@@ -431,7 +477,8 @@ def main() -> None:
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
-              "transfer": transfer, "metrics": obs_metrics}
+              "transfer": transfer, "locality": locality,
+              "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -440,17 +487,18 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "tpu", "transfer", "metrics"):
+    for section in ("micro_stats", "scale", "tpu", "transfer", "locality",
+                    "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
-                        tpu, transfer))
+                        tpu, transfer, locality))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
-                  transfer=None):
+                  transfer=None, locality=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -486,6 +534,14 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                 transfer["naive_source_bytes"]
                 / max(transfer["chain_max_source_bytes"], 1), 2),
         }
+    if locality and "error" not in locality:
+        # the scheduling acceptance numbers: fan-out speedup from going
+        # to the data, and the prestage overlapping queue wait
+        line["locality"] = {
+            "speedup": locality["locality_speedup"],
+            "bytes_avoided_mb": locality["locality_bytes_avoided_mb"],
+            "prefetch_overlap_ms": locality["prefetch_overlap_ms"],
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -508,7 +564,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("transfer", "micro", "scale"):
+        for k in ("locality", "transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

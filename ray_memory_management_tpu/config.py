@@ -86,6 +86,27 @@ _flag("transfer_broadcast_fanout", int, 2,
 _flag("scheduler_spread_threshold", float, 0.5,
       "Hybrid policy: pack onto the local/low-index nodes until utilization "
       "passes this, then spread (hybrid_scheduling_policy.h:48).")
+_flag("scheduler_locality_weight", float, 1.0,
+      "Soft data-locality score weight: among fitting nodes, prefer the "
+      "holder of the most argument bytes, traded off against utilization "
+      "and dispatch-queue depth (the owner-side locality-aware lease "
+      "policy, locality_aware_scheduling in the direct task transport). "
+      "0 disables locality scoring entirely. Always subordinate to hard "
+      "NodeAffinity / placement-group strategies and to spillback when "
+      "the holder is saturated.")
+_flag("locality_min_bytes", int, 256 * 1024,
+      "Locality scoring engages only when some fitting node holds at "
+      "least this many argument bytes — tiny args are cheaper to move "
+      "than a placement distortion is to absorb (inlined args never "
+      "count: they ship in the exec message).")
+_flag("argument_prefetch", bool, True,
+      "Pipelined argument prestage: when placement lands on a non-holder, "
+      "submit the task to the node's dispatch queue immediately and pull "
+      "its args concurrently, overlapping the transfer with queue wait "
+      "instead of serializing it in front of execution. Prestaged pulls "
+      "ride the broadcast-gate admission; a worker that wins the race "
+      "simply blocks on its arg get until the same copy lands "
+      "(create_or_wait dedupes). Off restores transfer-then-submit.")
 _flag("worker_prestart_count", int, 2,
       "Workers to prestart per node at startup (worker_pool.h prestart).")
 _flag("max_workers_per_node", int, 8,

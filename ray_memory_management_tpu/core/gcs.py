@@ -100,6 +100,11 @@ class GCS:
         self.pubsub = Pubsub()
         # object directory: object_id bytes -> set of NodeID with a sealed copy
         self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
+        # payload sizes alongside the directory (the reference's object
+        # directory carries object_size for exactly this reason:
+        # locality-aware leasing needs bytes, not just holder sets).
+        # Entries live and die with object_locations.
+        self.object_sizes: Dict[bytes, int] = {}
         self._node_index = 0
 
     # -- jobs ----------------------------------------------------------------
@@ -237,9 +242,12 @@ class GCS:
             return [k for k in self.kv if k.startswith(prefix)]
 
     # -- object directory ----------------------------------------------------
-    def add_object_location(self, oid: bytes, node_id: NodeID) -> None:
+    def add_object_location(self, oid: bytes, node_id: NodeID,
+                            size: Optional[int] = None) -> None:
         with self._lock:
             self.object_locations[oid].add(node_id)
+            if size is not None:
+                self.object_sizes[oid] = size
 
     def remove_object_location(self, oid: bytes, node_id: NodeID) -> None:
         with self._lock:
@@ -248,10 +256,27 @@ class GCS:
                 locs.discard(node_id)
                 if not locs:
                     del self.object_locations[oid]
+                    self.object_sizes.pop(oid, None)
 
     def get_object_locations(self, oid: bytes) -> Set[NodeID]:
         with self._lock:
             return set(self.object_locations.get(oid, ()))
+
+    def locate_objects(self, oids) -> Dict[bytes, tuple]:
+        """Batched directory lookup for the scheduler's locality pass:
+        ``{oid: (size_bytes, (holder NodeIDs...))}`` under ONE lock
+        acquisition (the router calls this once per scheduling batch, not
+        per oid per candidate node). Size is 0 when the directory never
+        learned it (the holder set is still valid — the scheduler just
+        can't weigh those bytes). Objects with no live directory entry
+        are absent from the result."""
+        out: Dict[bytes, tuple] = {}
+        with self._lock:
+            for oid in oids:
+                locs = self.object_locations.get(oid)
+                if locs:
+                    out[oid] = (self.object_sizes.get(oid, 0), tuple(locs))
+        return out
 
     def prune_location(self, oid: bytes, node_id: NodeID) -> None:
         """Drop a directory entry a fetch proved STALE (the holder said
@@ -283,6 +308,7 @@ class GCS:
         with self._lock:
             for oid in oids:
                 locs = self.object_locations.pop(oid, None)
+                self.object_sizes.pop(oid, None)
                 if locs:
                     out[oid] = locs
         return out
@@ -296,5 +322,6 @@ class GCS:
                 locs.discard(node_id)
                 if not locs:
                     del self.object_locations[oid]
+                    self.object_sizes.pop(oid, None)
                     orphaned.append(oid)
         return orphaned

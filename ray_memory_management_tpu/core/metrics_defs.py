@@ -48,6 +48,26 @@ DEFS: Dict[str, tuple] = {
         tag_keys=("node_id",))),
     "rmt_scheduler_pending_args": (Gauge, dict(
         description="Tasks waiting on argument dependencies.")),
+    "rmt_scheduler_locality_hits_total": (Counter, dict(
+        description="Placements (with locality scoring engaged) that "
+                    "landed on a node already holding >= locality_min_"
+                    "bytes of the task's argument bytes.")),
+    "rmt_scheduler_locality_misses_total": (Counter, dict(
+        description="Placements where some node held >= locality_min_"
+                    "bytes of the task's args but placement landed "
+                    "elsewhere (hard affinity, saturation spillback, or "
+                    "the weighted score preferring an idle node).")),
+    "rmt_scheduler_locality_bytes_avoided_total": (Counter, dict(
+        description="Argument bytes already resident on the chosen node "
+                    "at placement time — bytes the data plane never has "
+                    "to move because the scheduler went to the data.")),
+    "rmt_prefetch_started_total": (Counter, dict(
+        description="Argument prestage pulls launched for tasks placed "
+                    "on a non-holder (transfer overlaps dispatch-queue "
+                    "wait instead of serializing before execution).")),
+    "rmt_prefetch_completed_total": (Counter, dict(
+        description="Argument prestage pulls that landed (task's args "
+                    "were store-resident before a worker asked).")),
     # object / device stores
     "rmt_object_store_bytes": (Gauge, dict(
         description="Shared-memory object store bytes in use per node.",
@@ -178,6 +198,26 @@ def scheduler_queue_depth() -> Gauge:
 
 def scheduler_pending_args() -> Gauge:
     return get("rmt_scheduler_pending_args")
+
+
+def scheduler_locality_hits() -> Counter:
+    return get("rmt_scheduler_locality_hits_total")
+
+
+def scheduler_locality_misses() -> Counter:
+    return get("rmt_scheduler_locality_misses_total")
+
+
+def scheduler_locality_bytes_avoided() -> Counter:
+    return get("rmt_scheduler_locality_bytes_avoided_total")
+
+
+def prefetch_started() -> Counter:
+    return get("rmt_prefetch_started_total")
+
+
+def prefetch_completed() -> Counter:
+    return get("rmt_prefetch_completed_total")
 
 
 def object_store_bytes() -> Gauge:

@@ -25,6 +25,7 @@ import threading
 import time
 from collections import defaultdict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _CFTimeoutError
 from multiprocessing import connection as mpc
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -399,6 +400,8 @@ class Runtime:
         self._m_failed = mdefs.tasks_failed()
         self._m_retried = mdefs.tasks_retried()
         self._m_stage_hist = mdefs.task_stage_seconds()
+        self._m_prefetch_started = mdefs.prefetch_started()
+        self._m_prefetch_completed = mdefs.prefetch_completed()
         # dep-ready tasks awaiting scheduling, drained in BATCHES by the
         # router's pump: per-task inline scheduling cost ~7 lock/notify
         # round-trips; batching pays them once per burst (the reference
@@ -1276,7 +1279,8 @@ class Runtime:
             self._m_failed.inc()
         self._release_task_args(spec)
 
-    def _schedule(self, spec: TaskSpec, pump: bool = True) -> None:
+    def _schedule(self, spec: TaskSpec, pump: bool = True,
+                  locality: Optional[Dict[NodeID, int]] = None) -> None:
         if spec.task_id in self._cancelled:
             self._fail_task(spec, TaskError(spec.name, None, "cancelled"))
             return
@@ -1292,8 +1296,13 @@ class Runtime:
                     self._pending_schedule.append(spec)
                 return
         else:
+            if locality is None:
+                # non-batched callers (retries, node-death re-placement):
+                # compute this spec's locality solo
+                locality = self._batch_locality([spec]).get(spec.task_id)
             try:
-                node_id = self.scheduler.pick_node(spec.req, strategy)
+                node_id = self.scheduler.pick_node(spec.req, strategy,
+                                                   locality=locality)
             except ValueError as e:
                 self._fail_task(spec, TaskError(spec.name, None, str(e)))
                 return
@@ -1383,13 +1392,16 @@ class Runtime:
                 to_fetch.append((oid, locs))
         if not to_fetch:
             return True
+        prestage = bool(self.config.argument_prefetch)
 
-        def do_transfers():
+        def do_transfers(resubmit: bool = True):
             lost = None
             degraded = []
+            landed = 0
             for oid, locs in to_fetch:
                 try:
                     self._transfer_from(oid, locs, node_id)
+                    landed += 1
                 except Exception as e:  # noqa: BLE001
                     # A failed or backpressured prefetch must never fail
                     # the task while the object is still live somewhere:
@@ -1402,10 +1414,14 @@ class Runtime:
                     elif lost is None:
                         lost = (oid, e)
             if lost is not None:
-                # recovery re-places the task (and fails it only when the
-                # object is unrecoverable)
-                self._recover_then_reschedule(lost[0], spec, node_id)
-                return
+                if resubmit:
+                    # recovery re-places the task (and fails it only when
+                    # the object is unrecoverable)
+                    self._recover_then_reschedule(lost[0], spec, node_id)
+                    return
+                # prestaged task is already on the node's dispatch queue:
+                # its worker's arg get runs lineage recovery (_serve_get)
+                degraded.append(lost)
             if degraded:
                 events.emit(
                     "TRANSFER_DEGRADED",
@@ -1413,12 +1429,38 @@ class Runtime:
                     f"not prefetched (first: {degraded[0][0].hex()[:8]}: "
                     f"{degraded[0][1]!r}); worker will fetch inline",
                     severity=events.WARNING, source="object_manager")
+            if not resubmit:
+                # prestage epilogue: the task was submitted before the
+                # pull started — just account, stamp, and nudge dispatch
+                if landed:
+                    self._m_prefetch_completed.inc(landed)
+                with self._lock:
+                    rec = self.tasks.get(spec.task_id)
+                    if rec:
+                        rec.ts["PREFETCH_DONE"] = time.time()
+                self._wakeup()
+                return
             try:
                 self._submit_to_node(node_id, spec)
                 self._wakeup()
             except Exception as e:  # noqa: BLE001
                 self._fail_task(spec, TaskError(spec.name, e))
 
+        if prestage:
+            # pipelined argument prestage: hand the task to the node's
+            # dispatch queue NOW and pull its args concurrently, so the
+            # striped pull overlaps queue wait instead of serializing in
+            # front of execution. Safe because a worker that dequeues the
+            # task early simply blocks in its arg get until the SAME copy
+            # lands (create_or_wait dedupes racing fetches) or falls back
+            # to the inline-serve path.
+            with self._lock:
+                rec = self.tasks.get(spec.task_id)
+                if rec:
+                    rec.ts.setdefault("PREFETCH_START", time.time())
+            self._m_prefetch_started.inc(len(to_fetch))
+            self._transfer_pool.submit(do_transfers, False)
+            return True
         self._transfer_pool.submit(do_transfers)
         return False
 
@@ -1689,7 +1731,11 @@ class Runtime:
                     end = min(off + chunk, view.nbytes)
                     buf[off:end] = view[off:end]
                 dst_store.seal(oid)
-            self.gcs.add_object_location(oid, dst)
+                # same-host copies count as data movement too — without
+                # this the virtual-node benches under-report bytes moved
+                mdefs.transfer_bytes().observe(
+                    float(view.nbytes), tags={"direction": "local_copy"})
+            self.gcs.add_object_location(oid, dst, size=view.nbytes)
         finally:
             src_cli.release(oid)
 
@@ -1710,6 +1756,43 @@ class Runtime:
         except Exception as e:
             self._fail_task(spec, TaskError(spec.name, e))
 
+    def _batch_locality(self, specs) -> Dict[TaskID, Dict[NodeID, int]]:
+        """Per-task argument-bytes-by-node for a scheduling batch: ONE
+        batched GCS directory lookup (locate_objects) over the union of
+        every task's ref args, folded into ``{task_id: {node_id:
+        bytes}}`` for the scheduler's soft locality score. Memory-store
+        (inline) args never count — they ship in the exec message.
+        Tasks with no ref args are absent from the result (the common
+        no-arg task pays one attribute check, nothing else)."""
+        if self.config.scheduler_locality_weight <= 0:
+            return {}
+        want: Set[bytes] = set()
+        deps_by_task = []
+        for spec in specs:
+            deps = self._ref_deps(spec)
+            if deps:
+                deps_by_task.append((spec, deps))
+                want.update(deps)
+        if not want:
+            return {}
+        with self._lock:
+            want = {oid for oid in want if oid not in self.memory_store}
+        if not want:
+            return {}
+        directory = self.gcs.locate_objects(want)
+        out: Dict[TaskID, Dict[NodeID, int]] = {}
+        for spec, deps in deps_by_task:
+            acc: Dict[NodeID, int] = {}
+            for oid in deps:
+                size, holders = directory.get(oid, (0, ()))
+                if not size:
+                    continue
+                for nid in holders:
+                    acc[nid] = acc.get(nid, 0) + size
+            if acc:
+                out[spec.task_id] = acc
+        return out
+
     # ------------------------------------------------------------- dispatch
     def _pump(self) -> None:
         if self.pg_manager is not None:
@@ -1727,11 +1810,17 @@ class Runtime:
             pending = list(self._pending_schedule)
             self._pending_schedule.clear()
         # batched scheduling: place every queued task first (no per-task
-        # dispatch pump), then run ONE dispatch pass per node below
-        for spec in submits:
-            self._schedule(spec, pump=False)
-        for spec in pending:
-            self._schedule(spec, pump=False)
+        # dispatch pump), then run ONE dispatch pass per node below.
+        # Locality is computed for the WHOLE batch up front — one GCS
+        # directory lookup over the union of every task's ref args, not
+        # one per task per candidate node
+        for batch in (submits, pending):
+            if not batch:
+                continue
+            loc_by_task = self._batch_locality(batch)
+            for spec in batch:
+                self._schedule(spec, pump=False,
+                               locality=loc_by_task.get(spec.task_id, {}))
         for nm in list(self.nodes.values()):
             self._pump_node(nm)
 
@@ -1870,7 +1959,10 @@ class Runtime:
                     if kind == "v":
                         self.memory_store[oid] = data
                     else:
-                        self.gcs.add_object_location(oid, handle.node_id)
+                        # "store" returns carry total_size as the payload:
+                        # the directory learns bytes for locality scoring
+                        self.gcs.add_object_location(oid, handle.node_id,
+                                                     size=data)
                     fut = self.futures.get(oid)
                     if fut is None:
                         self.futures[oid] = fut = _SlimFuture()
@@ -2621,7 +2713,8 @@ class Runtime:
             self._flush_deferred_frees()
             nm = self.head_node()
             nm.store.put_serialized(oid, data)
-            self.gcs.add_object_location(oid, nm.node_id)
+            self.gcs.add_object_location(oid, nm.node_id,
+                                         size=data.total_size)
         with self._lock:
             fut = _SlimFuture()
             fut.set_result(True)
@@ -2656,7 +2749,8 @@ class Runtime:
                 self._flush_deferred_frees()  # see put_object
                 nm = self.head_node()
                 nm.store.put_serialized(oid, data)
-                self.gcs.add_object_location(oid, nm.node_id)
+                self.gcs.add_object_location(oid, nm.node_id,
+                                             size=data.total_size)
         with self._lock:
             fut = self.futures.get(oid)
             if fut is None:
@@ -2675,7 +2769,8 @@ class Runtime:
         oid = ObjectID.for_put().binary()
         nm = self.head_node()
         nm.store.put_serialized(oid, data)
-        self.gcs.add_object_location(oid, nm.node_id)
+        self.gcs.add_object_location(oid, nm.node_id,
+                                     size=data.total_size)
         with self._lock:
             fut = _SlimFuture()
             fut.set_result(True)
@@ -2712,7 +2807,9 @@ class Runtime:
                     0.0, deadline - time.monotonic())
                 try:
                     fut.result(timeout=remaining)
-                except TimeoutError:
+                # _CFTimeoutError is NOT the builtin TimeoutError until
+                # Python 3.11 — catch both so 3.10 converts too
+                except (TimeoutError, _CFTimeoutError):
                     raise GetTimeoutError(
                         f"get() timed out waiting for {oid.hex()}"
                     )
@@ -2932,7 +3029,8 @@ class Runtime:
         ownership attribution; the value is freed only by the owner's
         release (guarded against live driver pins)."""
         oid = msg["object_id"]
-        self.gcs.add_object_location(oid, handle.node_id)
+        self.gcs.add_object_location(oid, handle.node_id,
+                                     size=msg.get("size"))
         with self._lock:
             if msg.get("own", True):
                 self._worker_owned.setdefault(

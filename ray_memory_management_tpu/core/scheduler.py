@@ -25,7 +25,13 @@ from typing import Dict, List, Optional
 from ..config import Config
 from ..ids import NodeID
 from .gcs import GCS
-from .metrics_defs import scheduler_placements, scheduler_queue_depth
+from .metrics_defs import (
+    scheduler_locality_bytes_avoided,
+    scheduler_locality_hits,
+    scheduler_locality_misses,
+    scheduler_placements,
+    scheduler_queue_depth,
+)
 from .resources import NodeResources, Resources
 from .scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
@@ -45,13 +51,34 @@ class ClusterScheduler:
         # balance leases when every feasible node is at capacity
         self.load_fn = load_fn or (lambda node_id: 0)
         self._m_placements = scheduler_placements()
+        self._m_loc_hits = scheduler_locality_hits()
+        self._m_loc_misses = scheduler_locality_misses()
+        self._m_loc_bytes = scheduler_locality_bytes_avoided()
 
     # -- policy entry ---------------------------------------------------------
     def pick_node(self, req: Resources, strategy=None,
-                  queue_if_busy: bool = True) -> Optional[NodeID]:
-        node_id = self._pick_node(req, strategy, queue_if_busy)
+                  queue_if_busy: bool = True,
+                  locality: Optional[Dict[NodeID, int]] = None
+                  ) -> Optional[NodeID]:
+        """``locality`` maps candidate node -> argument bytes already
+        resident there (computed by the router's batched scheduling pass
+        from the GCS object directory). None/empty means no ref args or
+        locality disabled — the pre-locality policies apply unchanged."""
+        node_id = self._pick_node(req, strategy, queue_if_busy, locality)
         if node_id is not None:
             self._m_placements.inc()
+            if locality and self.config.scheduler_locality_weight > 0:
+                resident = locality.get(node_id, 0)
+                # hit/miss accounting engages only past the gate — below
+                # it the policy never weighed data placement at all
+                if max(locality.values()) >= self.config.locality_min_bytes:
+                    if resident >= self.config.locality_min_bytes:
+                        self._m_loc_hits.inc()
+                    else:
+                        self._m_loc_misses.inc()
+                if resident:
+                    # bytes the data plane never moves, however we landed
+                    self._m_loc_bytes.inc(resident)
         return node_id
 
     def publish_load(self) -> None:
@@ -63,8 +90,35 @@ class ClusterScheduler:
             g.set(float(self.load_fn(n.node_id)),
                   tags={"node_id": n.node_id.hex()[:12]})
 
+    def _locality_pick(self, fitting, locality) -> Optional[NodeID]:
+        """Soft locality score over the FITTING set (so it can never pick
+        an infeasible or saturated node — spillback and feasibility were
+        already decided). Engages only when some fitting node holds >=
+        locality_min_bytes of the task's args; the weighted score trades
+        resident bytes against utilization and dispatch-queue depth so a
+        busy holder loses to an idle peer once the queue-delay cost
+        outweighs the transfer it avoids."""
+        w = self.config.scheduler_locality_weight
+        if not locality or w <= 0:
+            return None
+        max_bytes = max(locality.get(n.node_id, 0) for n in fitting)
+        if max_bytes < self.config.locality_min_bytes:
+            return None
+
+        def score(n):
+            # bytes term normalized to [0, w]; utilization in [0, 1];
+            # queue depth squashed to [0, 1) so one pathological backlog
+            # can't dominate the comparison
+            load = self.load_fn(n.node_id)
+            return (w * (locality.get(n.node_id, 0) / max_bytes)
+                    - n.resources.utilization()
+                    - load / (load + 4.0))
+
+        return max(fitting, key=lambda n: (score(n), -n.index)).node_id
+
     def _pick_node(self, req: Resources, strategy=None,
-                   queue_if_busy: bool = True) -> Optional[NodeID]:
+                   queue_if_busy: bool = True, locality=None
+                   ) -> Optional[NodeID]:
         """Select a node to lease the task to.
 
         With ``queue_if_busy`` (the task path) a task always lands on SOME
@@ -132,6 +186,12 @@ class ClusterScheduler:
                                    (n.index + rr) % n_fit)
                 )
                 return fitting[0].node_id
+            # soft locality (default policy only — SPREAD is explicit
+            # anti-affinity, hard NodeAffinity/PG returned above): go to
+            # the data when enough of it already sits on a fitting node
+            chosen = self._locality_pick(fitting, locality)
+            if chosen is not None:
+                return chosen
             # hybrid: pack onto lowest-index node under the threshold, else
             # least-utilized (hybrid_scheduling_policy.h:48)
             threshold = self.config.scheduler_spread_threshold

@@ -461,7 +461,7 @@ class WorkerRuntimeProxy:
             with self._ref_lock:
                 self._owned.add(oid)
         self._worker.sender.send({"type": "owned_put", "object_id": oid,
-                                  "own": own})
+                                  "own": own, "size": data.total_size})
         return oid
 
     def put_object(self, value: Any) -> bytes:

@@ -277,6 +277,37 @@ class _StoreBufferView:
             self._notify()
 
 
+# pickle consumes out-of-band buffers through the C buffer protocol; a
+# pure-Python ``__buffer__`` only participates from Python 3.12 (PEP 688)
+_HAS_PEP688 = sys.version_info >= (3, 12)
+
+
+def _wrap_buffer(sl: memoryview, notify):
+    """Wrap one aligned store slice so its release is tied to the life of
+    whatever pickle reconstructs from it."""
+    if _HAS_PEP688:
+        return _StoreBufferView(sl, notify)
+    # Python < 3.12 ignores _StoreBufferView.__buffer__, so hand pickle a
+    # buffer it CAN consume: a zero-copy uint8 ndarray over the read-only
+    # slice. Reconstructed arrays keep it alive as their base, and the
+    # finalizer fires notify when the last of them dies — same lifetime
+    # semantics as the PEP-688 wrapper (memoryview itself cannot carry a
+    # weakref, ndarray can).
+    try:
+        import numpy as np
+    except ImportError:
+        # no numpy: copy the payload so the store ref can drop now; this
+        # buffer's share of the release fires immediately
+        data = bytes(sl)
+        notify()
+        return data
+    import weakref
+
+    arr = np.frombuffer(sl, dtype=np.uint8)
+    weakref.finalize(arr, notify)
+    return arr
+
+
 def deserialize(data: memoryview | bytes, on_release=None) -> Any:
     """Deserialize an envelope. If ``on_release`` is given, it is called once
     all zero-copy views into ``data`` are garbage (immediately if there are
@@ -319,7 +350,7 @@ def deserialize(data: memoryview | bytes, on_release=None) -> Any:
             pos = _align(pos)
             sl = mv[pos : pos + size].toreadonly()  # zero-copy, read-only
             if notify is not None:
-                buffers.append(_StoreBufferView(sl, notify))
+                buffers.append(_wrap_buffer(sl, notify))
             else:
                 buffers.append(sl)
             pos += size

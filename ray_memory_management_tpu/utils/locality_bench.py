@@ -1,0 +1,151 @@
+"""Locality-scheduling bench: large-arg task fan-out over virtual nodes.
+
+Measures what the locality-aware scheduler is for — the transfer that
+never happens. The workload: N large arguments produced round-robin
+across the cluster (hard NodeAffinity pins each producer), then a
+fan-out of consumer tasks submitted with the DEFAULT strategy. With
+``scheduler_locality_weight`` 0 the hybrid policy scatters consumers by
+utilization and most args must move; with the locality score on,
+consumers chase their bytes and the data plane goes quiet. Reported:
+tasks/s both ways, total bytes moved both ways (the transfer-plane
+histogram, including same-host copies), the locality counters, and a
+forced non-holder placement proving the argument prestage overlaps the
+dispatch-queue wait (PREFETCH_DONE after SCHEDULED in the task's
+lifecycle stamps).
+
+Runs in-process (virtual nodes, same-host memcpy transfer path) so the
+suite is hermetic; the cross-node win is strictly larger — BENCH_r05
+measured 4.74 GB/s cross-node vs 11.94 GB/s memcpy.
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+from typing import Dict
+
+MB = 1 << 20
+
+LOCALITY_DEFAULTS = dict(n_nodes=3, n_tasks=12, arg_mb=16, trials=2)
+
+
+def _transfer_bytes_total() -> float:
+    from ..core import metrics_defs as mdefs
+
+    return sum(mdefs.transfer_bytes()._sums.values())
+
+
+def _counter(acc: str) -> float:
+    from ..core import metrics_defs as mdefs
+
+    return sum(getattr(mdefs, acc)().series().values())
+
+
+def run_locality_suite(n_nodes: int = 3, n_tasks: int = 12,
+                       arg_mb: int = 16, trials: int = 2) -> Dict:
+    import numpy as np
+
+    import ray_memory_management_tpu as rmt
+    from ..config import Config
+    from ..core.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @rmt.remote
+    def produce(mb):
+        return np.ones(mb << 20, dtype=np.uint8)
+
+    @rmt.remote
+    def consume(x):
+        return int(x[0]) + x.nbytes
+
+    def pin(node_id):
+        return NodeAffinitySchedulingStrategy(node_id=node_id, soft=False)
+
+    def run_mode(weight: float) -> Dict:
+        cfg = Config(scheduler_locality_weight=weight)
+        rt = rmt.init(num_cpus=2, _config=cfg)
+        try:
+            nids = [rt.head_node().node_id]
+            for _ in range(n_nodes - 1):
+                nids.append(rt.add_node({"num_cpus": 2}))
+            # warm every node's worker pool so the first measured trial
+            # isn't paying worker spawns
+            rmt.get([consume.options(scheduling_strategy=pin(n)).remote(
+                produce.options(scheduling_strategy=pin(n)).remote(1))
+                for n in nids])
+            best = {"tasks_per_s": 0.0, "bytes_moved": 0.0}
+            for _ in range(trials):
+                # fresh args each trial: copies left behind by a previous
+                # trial's transfers would hide the off-mode cost
+                refs = [produce.options(
+                    scheduling_strategy=pin(nids[i % n_nodes])
+                ).remote(arg_mb) for i in range(n_tasks)]
+                rmt.get(refs)
+                moved0 = _transfer_bytes_total()
+                t0 = time.perf_counter()
+                outs = [consume.remote(r) for r in refs]
+                rmt.get(outs)
+                dt = time.perf_counter() - t0
+                rate = n_tasks / dt
+                if rate > best["tasks_per_s"]:
+                    best = {"tasks_per_s": rate,
+                            "bytes_moved": _transfer_bytes_total() - moved0}
+                del refs, outs
+                gc.collect()
+                time.sleep(0.1)
+            return best
+        finally:
+            rmt.shutdown()
+
+    hits0 = _counter("scheduler_locality_hits")
+    misses0 = _counter("scheduler_locality_misses")
+    avoided0 = _counter("scheduler_locality_bytes_avoided")
+    pf_started0 = _counter("prefetch_started")
+    pf_done0 = _counter("prefetch_completed")
+
+    off = run_mode(0.0)
+    on = run_mode(1.0)
+
+    # forced non-holder placement: the arg lives on one node, the task is
+    # pinned to another — the prestage must pull the arg WHILE the task
+    # rides the dispatch queue (PREFETCH_DONE stamped after SCHEDULED)
+    overlap_ms = 0.0
+    rt = rmt.init(num_cpus=2)
+    try:
+        holder = rt.add_node({"num_cpus": 2})
+        other = rt.add_node({"num_cpus": 2})
+        ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=holder, soft=False)).remote(arg_mb)
+        rmt.get(ref)
+        out = consume.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=other, soft=False)).remote(ref)
+        rmt.get(out)
+        for rec in rt.tasks.values():
+            ts = rec.ts
+            if "PREFETCH_DONE" in ts and "SCHEDULED" in ts:
+                overlap_ms = max(
+                    overlap_ms,
+                    (ts["PREFETCH_DONE"] - ts["SCHEDULED"]) * 1000.0)
+    finally:
+        rmt.shutdown()
+
+    return {
+        "n_nodes": n_nodes,
+        "n_tasks": n_tasks,
+        "arg_mb": arg_mb,
+        "locality_on_tasks_per_s": round(on["tasks_per_s"], 1),
+        "locality_off_tasks_per_s": round(off["tasks_per_s"], 1),
+        "locality_speedup": round(
+            on["tasks_per_s"] / max(off["tasks_per_s"], 1e-9), 2),
+        "bytes_moved_on_mb": round(on["bytes_moved"] / MB, 1),
+        "bytes_moved_off_mb": round(off["bytes_moved"] / MB, 1),
+        "locality_hits": round(_counter("scheduler_locality_hits") - hits0),
+        "locality_misses": round(
+            _counter("scheduler_locality_misses") - misses0),
+        "locality_bytes_avoided_mb": round(
+            (_counter("scheduler_locality_bytes_avoided") - avoided0) / MB,
+            1),
+        "prefetch_started": round(_counter("prefetch_started") - pf_started0),
+        "prefetch_completed": round(
+            _counter("prefetch_completed") - pf_done0),
+        "prefetch_overlap_ms": round(overlap_ms, 2),
+    }

@@ -78,6 +78,29 @@ class TestPrometheusExposition:
         finally:
             metrics.clear_registry()
 
+    def test_locality_and_prefetch_series_in_exposition(self):
+        """Golden coverage for the locality-scheduler / prestage series:
+        each new counter must surface in the exposition with sane HELP
+        and TYPE lines once it has moved."""
+        new = ("rmt_scheduler_locality_hits_total",
+               "rmt_scheduler_locality_misses_total",
+               "rmt_scheduler_locality_bytes_avoided_total",
+               "rmt_prefetch_started_total",
+               "rmt_prefetch_completed_total")
+        for name in new:
+            assert name in mdefs.DEFS, name
+            mdefs.get(name).inc(1)
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in new:
+            assert f"# TYPE {name} counter" in lines, name
+            assert any(line.startswith(f"# HELP {name} ") and
+                       len(line) > len(f"# HELP {name} ")
+                       for line in lines), name
+            assert any(line.startswith(name) and
+                       float(line.rsplit(" ", 1)[1]) > 0
+                       for line in lines), name
+
     def test_canonical_defs_construct(self):
         """Every declared instrument is constructible and re-entrant
         (aliases prior storage instead of shadowing it)."""
