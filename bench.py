@@ -351,6 +351,40 @@ def _locality_suite():
         return {"error": repr(e)}
 
 
+# tracing-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): tasks/s on a no-op
+# fan-out with the trace plane on vs off, and the overhead percentage
+# the ISSUE caps at 5%.
+REQUIRED_TRACING_FIELDS = (
+    "tracing_on_tasks_per_s", "tracing_off_tasks_per_s",
+    "tracing_overhead_pct", "n_tasks", "trials",
+)
+
+
+def _tracing_suite():
+    """Trace-plane overhead (utils/tracing_bench.py); fault-isolated so
+    a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.tracing_bench import (
+            run_tracing_suite,
+        )
+
+        out = run_tracing_suite()
+        print(
+            f"  tracing fan-out ({out['n_tasks']} no-op tasks): "
+            f"{out['tracing_on_tasks_per_s']:.0f} tasks/s on vs "
+            f"{out['tracing_off_tasks_per_s']:.0f} off "
+            f"({out['tracing_overhead_pct']:+.1f}% overhead)",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_TRACING_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  tracing suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -469,6 +503,7 @@ def main() -> None:
 
     transfer = _transfer_suite()
     locality = _locality_suite()
+    tracing = _tracing_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
 
@@ -478,7 +513,7 @@ def main() -> None:
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
               "transfer": transfer, "locality": locality,
-              "metrics": obs_metrics}
+              "tracing": tracing, "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -488,17 +523,17 @@ def main() -> None:
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "tpu", "transfer", "locality",
-                    "metrics"):
+                    "tracing", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
-                        tpu, transfer, locality))
+                        tpu, transfer, locality, tracing))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
-                  transfer=None, locality=None):
+                  transfer=None, locality=None, tracing=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -542,6 +577,11 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             "bytes_avoided_mb": locality["locality_bytes_avoided_mb"],
             "prefetch_overlap_ms": locality["prefetch_overlap_ms"],
         }
+    if tracing and "error" not in tracing:
+        # the trace-plane acceptance number: fan-out overhead (<=5%)
+        line["tracing"] = {
+            "overhead_pct": tracing["tracing_overhead_pct"],
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -564,7 +604,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("locality", "transfer", "micro", "scale"):
+        for k in ("tracing", "locality", "transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

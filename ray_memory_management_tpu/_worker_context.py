@@ -44,3 +44,22 @@ def backend():
     raise RuntimeError(
         "not initialized: call ray_memory_management_tpu.init() first"
     )
+
+
+def get_trace_context():
+    """The (trace_id, span_id, parent_span_id) context of the task this
+    process is currently executing, or None outside a traced task. In a
+    worker this is set around exec by the dispatcher; nested ``.remote()``
+    submits read it so child tasks chain onto the parent's trace."""
+    from .utils import tracing
+
+    return tracing.get_current()
+
+
+def set_trace_context(ctx):
+    """Install a trace context for the current thread (returns the reset
+    token — primarily for drivers that want several submits grouped
+    under one hand-minted trace)."""
+    from .utils import tracing
+
+    return tracing.set_current(ctx)

@@ -155,6 +155,12 @@ DEFS: Dict[str, tuple] = {
     # done-reply/flush piggyback channel)
     "rmt_worker_tasks_executed_total": (Counter, dict(
         description="Tasks executed, counted worker-side.")),
+    # observability plane itself
+    "rmt_timeline_events_dropped_total": (Counter, dict(
+        description="Timeline spans evicted from the bounded event ring "
+                    "(oldest-first) before they could be dumped; counted "
+                    "in whichever process dropped them and merged into "
+                    "the head registry via the flush channel.")),
 }
 
 
@@ -322,3 +328,7 @@ def worker_heartbeat_age_seconds() -> Gauge:
 
 def worker_tasks_executed() -> Counter:
     return get("rmt_worker_tasks_executed_total")
+
+
+def timeline_events_dropped() -> Counter:
+    return get("rmt_timeline_events_dropped_total")

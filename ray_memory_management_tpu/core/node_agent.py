@@ -496,6 +496,7 @@ class NodeAgent:
         host = msg["host"] or self._head_ip
         port, oid, req = msg["port"], msg["oid"], msg["req"]
         src_store = msg.get("src_store")
+        trace = msg.get("trace")
         # alternate live holders (head-resolved) for mid-pull failover;
         # host "" means the head itself, as with the primary source
         alts = [(h or self._head_ip, p) for h, p in msg.get("alts") or ()]
@@ -518,7 +519,8 @@ class NodeAgent:
                             base_backoff_s=self.config.transfer_retry_backoff_s,
                             plane="transfer"),
                         verify_checksum=self.config.transfer_verify_checksum,
-                        stripe_deadline=self.config.transfer_stripe_deadline_s)
+                        stripe_deadline=self.config.transfer_stripe_deadline_s,
+                        trace=trace)
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
             try:
@@ -739,16 +741,26 @@ class NodeAgent:
                     pass
             elif t == "ping":
                 from ..utils import events as _events
+                from ..utils import timeline as _timeline
 
                 evs = _events.drain_events(node_id=self.node_id.hex())
+                # timeline spans recorded in THIS process (transfer
+                # serves, spill IO) ship on the keepalive reply — the
+                # agent analog of the worker's profile piggyback; without
+                # it agent-side spans never reach the head's dump
+                prof = _timeline.drain_events_if_due(min_batch=1)
                 pong: Dict[str, Any] = {"type": "pong"}
                 if evs:
                     pong["events"] = evs
+                if prof:
+                    pong["profile"] = prof
                 try:
                     self._send(pong)
                 except (OSError, BrokenPipeError):
                     if evs:
                         _events.ingest(evs)  # retry on next ping
+                    if prof:
+                        _timeline.ingest_events(prof)
                     return
             elif t == "shutdown":
                 return

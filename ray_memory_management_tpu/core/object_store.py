@@ -31,7 +31,7 @@ from ..exceptions import ObjectStoreFullError
 from ..native import ShmStore, ShmStoreFullError
 from . import external_storage as ext
 from ..serialization import SerializedObject
-from ..utils import faults
+from ..utils import faults, timeline, tracing
 from ..utils.integrity import crc32
 from ..utils.retry import RetryExhausted, RetryPolicy
 
@@ -277,7 +277,14 @@ class NodeObjectStore:
             max_attempts=self.config.spill_retry_attempts,
             base_backoff_s=self.config.spill_retry_backoff_s,
             plane="spill")
+        t0 = time.time()
         url = policy.run(once)
+        # spill-write span: usually pressure-driven (no task context), but
+        # a spill forced under a traced task's allocation carries its trace
+        timeline.record_event(
+            f"spill::write::{object_id.hex()[:8]}", "spill", t0,
+            time.time(), extra={"bytes": view.nbytes},
+            trace=tracing.get_current())
         self._spill_crc[object_id] = want
         return url
 
@@ -482,10 +489,17 @@ class NodeObjectStore:
             url = self._spilled.get(object_id)
         if url is None:
             return self.shm.get(object_id)
+        t0 = time.time()
         try:
             data = self._spill_read(object_id, url)
         except (OSError, RetryExhausted):
             return None  # concurrently delete()d, or unrecoverable IO
+        # restore span: when a traced task's arg get forced the restore,
+        # the current context links the disk read into its causal chain
+        timeline.record_event(
+            f"spill::restore::{object_id.hex()[:8]}", "spill", t0,
+            time.time(), extra={"bytes": len(data)},
+            trace=tracing.get_current())
         try:
             buf = self._create_with_spill(object_id, len(data))
         except ValueError:

@@ -307,15 +307,18 @@ class RemoteNodeManager(NodeManager):
     def fetch_from_peer(self, oid: bytes, host: str, port: int,
                         timeout: float = 120.0,
                         src_store: Optional[str] = None,
-                        alts: Optional[list] = None) -> Optional[str]:
+                        alts: Optional[list] = None,
+                        trace=None) -> Optional[str]:
         """Tell the agent to pull ``oid`` straight from a peer's transfer
         server (host "" = the head). ``src_store`` names the source's shm
         segment when the peer shares the agent's host — the agent then
         maps it and memcpys instead of speaking TCP. ``alts`` lists other
         live holders' transfer addresses (head-resolved) so the agent can
-        fail a stalled pull over mid-stripe. Returns None on success,
-        else an error string. Payload bytes never touch the head or this
-        channel."""
+        fail a stalled pull over mid-stripe. ``trace`` is the trace
+        context of the task the pull serves; it rides the fetch frame and
+        the agent's wire requests so serve spans land on the task's
+        causal chain. Returns None on success, else an error string.
+        Payload bytes never touch the head or this channel."""
         if not self.alive:
             return "node dead"
         req = self._new_req()
@@ -325,6 +328,8 @@ class RemoteNodeManager(NodeManager):
             msg["src_store"] = src_store
         if alts:
             msg["alts"] = list(alts)
+        if trace:
+            msg["trace"] = tuple(trace)
         with self._pending_lock:
             state = self._pending.get(req)
         if state is None or not self.channel_send(msg):

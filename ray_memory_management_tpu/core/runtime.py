@@ -42,7 +42,7 @@ from ..exceptions import (
     WorkerCrashedError,
 )
 from ..ids import ActorID, JobID, NodeID, ObjectID, TaskID
-from ..utils import events
+from ..utils import events, timeline, tracing
 from .gcs import (
     ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING, ActorRecord, GCS,
 )
@@ -279,6 +279,42 @@ def stage_durations(ts: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+# Driver-side lifecycle spans emitted once per finished task from its
+# transition stamps: (span name, start stamp, end stamp). The worker
+# emits the matching exec slice (RUNNING→WORKER_DONE) in its own
+# process; sharing the task's span_id makes them one flow group, which
+# is how Perfetto draws submit→schedule→dispatch→exec→result arrows
+# across the process boundary.
+_LIFECYCLE_SPANS = (
+    ("submit", "SUBMITTED", ("QUEUED", "SCHEDULED", "DISPATCHED",
+                             "RUNNING")),
+    ("schedule", "QUEUED", ("SCHEDULED",)),
+    ("dispatch", "SCHEDULED", ("DISPATCHED",)),
+    ("queue", "DISPATCHED", ("RUNNING",)),
+    ("prefetch_wait", "PREFETCH_START", ("PREFETCH_DONE",)),
+    ("result", "WORKER_DONE", ("FINISHED", "FAILED")),
+)
+
+
+def emit_lifecycle_spans(name: str, task_id: bytes, trace_ctx,
+                         ts: Dict[str, float]) -> None:
+    """Record the head-side stage spans of one completed task on the
+    timeline, each carrying the task's trace context (actor tasks skip
+    the queue/schedule stamps — their submit span ends at the first
+    stamp that exists)."""
+    targs = {"task_id": task_id.hex()}
+    for stage, a, ends in _LIFECYCLE_SPANS:
+        ta = ts.get(a)
+        if ta is None:
+            continue
+        tb = next((ts[b] for b in ends if b in ts), None)
+        if tb is None or tb < ta:
+            continue
+        timeline.record_event(
+            f"{stage}::{name}", "lifecycle", ta, tb, tid="lifecycle",
+            extra={**targs, "stage": stage}, trace=trace_ctx)
+
+
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "state", "payload",
                  "args_released", "gc_returns", "ts")
@@ -393,6 +429,13 @@ class Runtime:
         # summaries (state.summarize_task_latencies); the stage histogram
         # metric keeps the unbounded bucketed view
         self.task_latencies: Dict[str, deque] = {}
+        # trace plane: trace_id -> [task_id, ...] so state.get_trace /
+        # summarize_critical_path can find a trace's tasks without
+        # scanning the whole table; insertion-ordered, oldest trace
+        # evicted past the cap (one trace can hold many tasks, so the
+        # bound is on traces, matching task_history's retention spirit)
+        self._traces: Dict[str, List[bytes]] = {}
+        self._traces_cap = 2_000
         # hot-path instruments hoisted once (accessor calls touch the
         # registry lock)
         self._m_submitted = mdefs.tasks_submitted()
@@ -785,8 +828,11 @@ class Runtime:
                 self._on_worker_death(handle)
         elif mtype == "pong":
             # remote agents flush their structured-event buffer on the
-            # keepalive reply (node_agent.py ping handler)
+            # keepalive reply (node_agent.py ping handler); timeline
+            # spans recorded agent-side (transfer serves, spill IO) ride
+            # the same reply so the head's dump covers every process
             events.ingest(msg.get("events") or [])
+            timeline.ingest_events(msg.get("profile") or [])
 
     def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
         from .remote_node import VirtualConn
@@ -1093,8 +1139,6 @@ class Runtime:
             # flush): straggler spans, plus optional piggybacked event
             # and metric-series batches that merge into the head's
             # buffers/registry (the agent->head aggregation path)
-            from ..utils import timeline
-
             if msg.get("profile"):
                 timeline.ingest_events(msg["profile"])
             if msg.get("events"):
@@ -1136,6 +1180,20 @@ class Runtime:
             pass
 
     # ------------------------------------------------------- task submission
+    def _index_trace_locked(self, trace_ctx, task_id: bytes) -> None:
+        """With self._lock held: register a task under its trace so the
+        state API can reconstruct the span tree after records prune.
+        Python dicts iterate in insertion order, so eviction past the cap
+        drops the OLDEST trace."""
+        if not trace_ctx:
+            return
+        tasks = self._traces.get(trace_ctx[0])
+        if tasks is None:
+            while len(self._traces) >= self._traces_cap:
+                self._traces.pop(next(iter(self._traces)), None)
+            tasks = self._traces[trace_ctx[0]] = []
+        tasks.append(task_id)
+
     def submit_task(self, payload: dict,
                     adopt_returns: bool = True) -> List[bytes]:
         task_id = TaskID.for_task(self.job_id)
@@ -1145,6 +1203,13 @@ class Runtime:
         ]
         if payload.get("fn_blob") is not None:
             self.fn_blobs.setdefault(payload["fn_id"], payload["fn_blob"])
+        # trace plane: a nested submit carries its parent context on the
+        # payload (attached worker-side by WorkerRuntimeProxy); a driver
+        # submit inherits any context the caller installed, else this
+        # task roots a fresh trace
+        parent_ctx = tracing.from_wire(payload.get("trace_parent")) \
+            or tracing.get_current()
+        trace_ctx = tracing.child_of(parent_ctx)
         spec = TaskSpec(
             task_id=task_id.binary(),
             name=payload.get("name", "task"),
@@ -1160,12 +1225,14 @@ class Runtime:
             ),
             retry_exceptions=payload.get("retry_exceptions", False),
             runtime_env=payload.get("runtime_env"),
+            trace_ctx=trace_ctx,
         )
         rec = _TaskRecord(spec, payload, spec.max_retries,
                           gc_returns=adopt_returns)
         self._m_submitted.inc()
         with self._lock:
             self.tasks[spec.task_id] = rec
+            self._index_trace_locked(trace_ctx, spec.task_id)
             with self._ref_mu:
                 for oid in return_ids:
                     self.futures[oid] = _SlimFuture()
@@ -1459,10 +1526,24 @@ class Runtime:
                 if rec:
                     rec.ts.setdefault("PREFETCH_START", time.time())
             self._m_prefetch_started.inc(len(to_fetch))
-            self._transfer_pool.submit(do_transfers, False)
+            self._transfer_pool.submit(
+                self._with_trace, spec.trace_ctx, do_transfers, False)
             return True
-        self._transfer_pool.submit(do_transfers)
+        self._transfer_pool.submit(
+            self._with_trace, spec.trace_ctx, do_transfers)
         return False
+
+    @staticmethod
+    def _with_trace(ctx, fn, *args):
+        """Run ``fn`` on this (pool) thread with ``ctx`` installed as the
+        current trace context: transfers happen off the submitting thread,
+        so the context must travel to the thread doing the IO for the
+        spans/wire-requests it records to name the right task."""
+        token = tracing.set_current(ctx)
+        try:
+            return fn(*args)
+        finally:
+            tracing.reset(token)
 
     def _object_alive(self, oid: bytes) -> bool:
         """True while ANY live copy exists: the driver memory store, or a
@@ -1624,6 +1705,25 @@ class Runtime:
         return srv
 
     def _transfer_object(self, oid: bytes, src: NodeID, dst: NodeID) -> None:
+        """Move an object between node stores, recording ONE transfer
+        span per movement (every path — memcpy, channel push, p2p pull —
+        funnels through here). The span is a CHILD of the current trace
+        context (the task the transfer serves, installed by _with_trace),
+        so Perfetto draws task→transfer arrows and the critical-path
+        summary can attribute the time."""
+        cur = tracing.get_current()
+        ctx = tracing.child_of(cur) if cur else None
+        t0 = time.time()
+        try:
+            self._transfer_object_impl(oid, src, dst, trace=ctx)
+        finally:
+            timeline.record_event(
+                f"transfer::{oid.hex()[:8]}", "transfer", t0, time.time(),
+                extra={"oid": oid.hex(), "src": str(src), "dst": str(dst)},
+                trace=ctx)
+
+    def _transfer_object_impl(self, oid: bytes, src: NodeID, dst: NodeID,
+                              trace=None) -> None:
         """Move an object between node stores. Same-host pairs memcpy
         between shm mappings. Pairs involving a remote node are
         RECEIVER-DRIVEN over the p2p transfer plane (transfer.py): the
@@ -1654,7 +1754,8 @@ class Runtime:
             if addr is not None:
                 err = dst_nm.fetch_from_peer(oid, addr[0], addr[1],
                                              src_store=src_store,
-                                             alts=self._holder_addrs(oid))
+                                             alts=self._holder_addrs(oid),
+                                             trace=trace)
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -1679,7 +1780,8 @@ class Runtime:
                     alt_sources=lambda: self._holder_addrs(oid),
                     retry=self._fetch_policy(),
                     verify_checksum=self.config.transfer_verify_checksum,
-                    stripe_deadline=self.config.transfer_stripe_deadline_s)
+                    stripe_deadline=self.config.transfer_stripe_deadline_s,
+                    trace=trace)
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -1888,6 +1990,11 @@ class Runtime:
                 msg["visible_chips"] = ",".join(
                     str(c) for c in handle.visible_chips
                 )
+        if spec.trace_ctx:
+            # the dispatch frame carries the task's trace context so the
+            # worker's exec span (and any nested submit inside the task
+            # body) lands on the same causal chain
+            msg["trace_ctx"] = spec.trace_ctx
         return msg
 
     def _finalize_arg(self, arg):
@@ -1909,8 +2016,6 @@ class Runtime:
             if m.get("profile"):
                 profile.extend(m["profile"])
         if profile:
-            from ..utils import timeline
-
             timeline.ingest_events(profile)
         nm = self.nodes.get(handle.node_id)
         for m in msgs:
@@ -1953,6 +2058,10 @@ class Runtime:
         to_free: List[bytes] = []
         done_t = time.time()  # one stamp for the whole burst
         stage_durs: List[Dict[str, float]] = []
+        # head-side lifecycle spans: collected under the lock, emitted
+        # outside it (record_event takes the timeline lock)
+        trace_spans: Optional[List[tuple]] = \
+            [] if timeline.is_enabled() else None
         with self._lock:
             for m, spec in simple:
                 for oid, kind, data in m["returns"]:
@@ -1982,6 +2091,10 @@ class Runtime:
                         rec.ts.update(wt)
                     rec.ts["FINISHED"] = done_t
                     stage_durs.append(stage_durations(rec.ts))
+                    if trace_spans is not None:
+                        trace_spans.append(
+                            (rec.spec.name, rec.spec.task_id,
+                             rec.spec.trace_ctx, dict(rec.ts)))
                 # arg release + fire-and-forget GC stay inside the batch
                 # lock (per-task locking was the completion side's
                 # dominant cost); only the zero-ref free_object calls run
@@ -2006,6 +2119,9 @@ class Runtime:
                             if roid not in self.local_refs)
         _SlimFuture.broadcast()  # wake getters once for the whole burst
         self._m_finished.inc(len(simple))
+        if trace_spans:
+            for name, tid_, tctx, ts in trace_spans:
+                emit_lifecycle_spans(name, tid_, tctx, ts)
         if stage_durs:
             self._record_task_latencies(stage_durs)
         self.free_objects(to_free)
@@ -2195,6 +2311,9 @@ class Runtime:
         return_ids = [
             ObjectID.for_return(task_id, i).binary() for i in range(num_returns)
         ]
+        parent_ctx = tracing.from_wire(payload.get("trace_parent")) \
+            or tracing.get_current()
+        trace_ctx = tracing.child_of(parent_ctx)
         spec = TaskSpec(
             task_id=task_id.binary(),
             name=f"{info.spec.name}.{payload['method']}",
@@ -2208,12 +2327,14 @@ class Runtime:
             method=payload["method"],
             seq=next(info.seq),
             max_retries=info.spec.max_task_retries,
+            trace_ctx=trace_ctx,
         )
         rec = _TaskRecord(spec, payload, info.spec.max_task_retries,
                           gc_returns=adopt_returns)
         self._m_submitted.inc()
         with self._lock:
             self.tasks[spec.task_id] = rec
+            self._index_trace_locked(trace_ctx, spec.task_id)
             with self._ref_mu:
                 for oid in return_ids:
                     self.futures[oid] = _SlimFuture()
@@ -3186,7 +3307,8 @@ class Runtime:
                 # lazily on read
                 self.task_history.append(
                     (tid, rec.spec.name, rec.state, rec.spec.num_returns,
-                     rec.retries_left, rec.spec.is_actor_task, rec.ts))
+                     rec.retries_left, rec.spec.is_actor_task, rec.ts,
+                     rec.spec.trace_ctx))
                 del self.tasks[tid]
                 for a in self._ref_deps(rec.spec):
                     n = self._lineage_dependents.get(a, 0) - 1

@@ -21,8 +21,8 @@ class TaskSpec:
         "task_id", "name", "fn_id", "args", "kwargs", "num_returns",
         "return_ids", "resources", "strategy", "max_retries",
         "retry_exceptions", "actor_id", "method", "seq",
-        "runtime_env", "placement", "depth", "_ref_deps_cache",
-        "_conda_key", "_req_cache",
+        "runtime_env", "placement", "depth", "trace_ctx",
+        "_ref_deps_cache", "_conda_key", "_req_cache",
     )
 
     def __init__(
@@ -44,6 +44,7 @@ class TaskSpec:
         runtime_env: Optional[dict] = None,
         placement: Optional[tuple] = None,  # (pg_id_bytes, bundle_index)
         depth: int = 0,
+        trace_ctx: Optional[tuple] = None,  # (trace_id, span_id, parent)
     ):
         self.task_id = task_id
         self.name = name
@@ -62,6 +63,7 @@ class TaskSpec:
         self.runtime_env = runtime_env
         self.placement = placement
         self.depth = depth
+        self.trace_ctx = trace_ctx
         self._ref_deps_cache: Optional[List[bytes]] = None
         # memoized conda-env key: computed once at first dispatch, not
         # re-hashed under the node lock every dispatch round

@@ -33,7 +33,11 @@ config.WIRE_PROTOCOL_VERSION — mismatches are refused per request, naming
 both versions):
     client -> server   {"oid": <bytes>, "proto": <int>,
                         "offset": <int>?, "length": <int>?,
-                        "defer_above": <int>?}
+                        "defer_above": <int>?, "trace": <list>?}
+    ..."trace" is an additive optional (trace_id, span_id, parent) tuple
+    naming the task the pull serves; the server records its serve span
+    under it so stripe pulls and broadcast-tree hops land on the
+    submitting task's causal chain in the timeline dump.
     server -> client   {"size": <span>, "total": <nbytes>}      (payload)
                   or   {"size": <nbytes>, "deferred": true}     (no payload)
                   or   {"error": <str>}
@@ -301,6 +305,8 @@ class TransferServer:
                 return False
         corrupt = act is not None and act.mode == "corrupt"
         oid = req["oid"]
+        trace = req.get("trace")
+        w0 = time.time()
         view = self.store.read(oid)
         if view is None:
             conn.send({"error": "object not in store"})
@@ -350,6 +356,20 @@ class TransferServer:
             if offset or (length is not None and span < n):
                 _count("transfer_stripe_requests")
             _observe_transfer("serve", span, time.monotonic() - t0)
+            if trace:
+                # serve-side span in THIS process's ring (agents ship it
+                # to the head on the keepalive pong), carrying the trace
+                # of the task the pull serves
+                try:
+                    from ..utils import timeline, tracing
+
+                    timeline.record_event(
+                        f"serve::{oid.hex()[:8]}", "transfer", w0,
+                        time.time(),
+                        extra={"offset": offset, "length": span},
+                        trace=tracing.from_wire(trace))
+                except Exception:  # noqa: BLE001 — never fail a serve
+                    pass
             return True
         finally:
             if isinstance(view, memoryview):
@@ -572,12 +592,15 @@ def _recv_exact(conn, sub) -> None:
 
 
 def _request_range(conn, oid: bytes, offset: int, length: int, sub,
-                   proto: int) -> None:
+                   proto: int, trace=None) -> None:
     """One range request on an authenticated connection: header exchange,
     then stream the span straight into ``sub``. Raises on any mismatch
     or stream failure (caller aborts the whole fetch)."""
-    conn.send({"oid": oid, "proto": proto, "offset": offset,
-               "length": length})
+    req = {"oid": oid, "proto": proto, "offset": offset,
+           "length": length}
+    if trace:
+        req["trace"] = tuple(trace)
+    conn.send(req)
     hdr = conn.recv()
     err = hdr.get("error")
     if err:
@@ -611,7 +634,8 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                  alt_sources: Optional[Callable] = None,
                  retry: Optional[RetryPolicy] = None,
                  verify_checksum: bool = True,
-                 stripe_deadline: Optional[float] = None) -> Optional[str]:
+                 stripe_deadline: Optional[float] = None,
+                 trace=None) -> Optional[str]:
     """Pull one object from a peer's TransferServer straight into
     ``dst_store``. Returns None on success, an error string on failure.
 
@@ -662,7 +686,8 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
         h, p = sources[attempt % len(sources)]
         err = _fetch_once(h, p, authkey, oid, dst_store, chunk_size,
                           timeout, pool, stripe_threshold, stripe_count,
-                          alt_sources, verify_checksum, stripe_deadline)
+                          alt_sources, verify_checksum, stripe_deadline,
+                          trace=trace)
         if err is None:
             return None
         if not policy.is_retryable(err):
@@ -689,7 +714,8 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                 stripe_count: Optional[int],
                 alt_sources: Optional[Callable],
                 verify_checksum: bool,
-                stripe_deadline: Optional[float]) -> Optional[str]:
+                stripe_deadline: Optional[float],
+                trace=None) -> Optional[str]:
     """One fetch attempt from one source (the pre-policy fetch_object
     body). Returns None on success, an error string on failure; never
     leaves an unsealed create behind."""
@@ -731,8 +757,11 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
             # whatever (possibly stripe-deadline-short) timeout its last
             # user set
             _set_io_timeout(conn.fileno(), min(timeout, 30.0))
-            conn.send({"oid": oid, "proto": WIRE_PROTOCOL_VERSION,
-                       "defer_above": stripe_threshold})
+            first_req = {"oid": oid, "proto": WIRE_PROTOCOL_VERSION,
+                         "defer_above": stripe_threshold}
+            if trace:
+                first_req["trace"] = tuple(trace)
+            conn.send(first_req)
             hdr = conn.recv()
             break
         except Exception as e:  # noqa: BLE001 — dead pooled conn
@@ -801,7 +830,8 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                               alt_sources=alt_sources,
                               expect_crc=expect_crc,
                               verify_checksum=verify_checksum,
-                              stripe_deadline=stripe_deadline)
+                              stripe_deadline=stripe_deadline,
+                              trace=trace)
     except _ChecksumMismatch as e:
         # the stream was fully consumed before the verify — the
         # connection stays usable, but the payload is poison
@@ -826,8 +856,8 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
                    alt_sources: Optional[Callable] = None,
                    expect_crc: Optional[int] = None,
                    verify_checksum: bool = True,
-                   stripe_deadline: Optional[float] = None
-                   ) -> Optional[str]:
+                   stripe_deadline: Optional[float] = None,
+                   trace=None) -> Optional[str]:
     """Fan ``total`` bytes out as parallel range requests into disjoint
     slices of ``buf`` (the already-created, unsealed allocation).
     ``first_conn`` carries stripe 0; each other stripe acquires its own
@@ -860,7 +890,7 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
             _set_io_timeout(conn.fileno(),
                             min(stripe_deadline, timeout))
             _request_range(conn, oid, offset, span, sub,
-                           WIRE_PROTOCOL_VERSION)
+                           WIRE_PROTOCOL_VERSION, trace=trace)
             c = crc32(sub) if verify_checksum else 0
         except BaseException as e:  # noqa: BLE001
             ConnectionPool.discard(conn)

@@ -36,7 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from .. import serialization as ser
-from ..utils import faults
+from ..utils import faults, tracing
 from .object_store import StoreClient
 
 # Actor classes preloaded by the ZYGOTE before forking (zygote.serve):
@@ -347,12 +347,25 @@ class WorkerRuntimeProxy:
             ev.set()
 
     # -- API used by core.api when running inside a worker --------------------
+    @staticmethod
+    def _attach_trace_parent(payload: dict) -> dict:
+        """A nested submit carries the EXECUTING task's trace context as
+        its parent: the head minting the child spec chains its span onto
+        it, which is what makes fan-out inside a task body one causal
+        tree instead of a forest of fresh traces."""
+        ctx = tracing.get_current()
+        if ctx is not None and "trace_parent" not in payload:
+            payload["trace_parent"] = ctx
+        return payload
+
     def submit_task(self, payload: dict) -> List[bytes]:
-        reply = self._request({"type": "submit_task", "payload": payload})
+        reply = self._request({"type": "submit_task",
+                               "payload": self._attach_trace_parent(payload)})
         return reply["return_ids"]
 
     def submit_actor_task(self, payload: dict) -> List[bytes]:
-        reply = self._request({"type": "submit_actor_task", "payload": payload})
+        reply = self._request({"type": "submit_actor_task",
+                               "payload": self._attach_trace_parent(payload)})
         return reply["return_ids"]
 
     def create_actor(self, payload: dict) -> bytes:
@@ -693,6 +706,12 @@ class Worker:
         pinned: List[bytes] = []
         args = kwargs = result = returns = None
         t0 = time.time()
+        # install the task's trace context for the duration of the call:
+        # the exec span lands on the submitting trace, and any nested
+        # .remote() inside the task body chains onto it (the proxy reads
+        # the current context when it attaches trace_parent)
+        trace_ctx = tracing.from_wire(msg.get("trace_ctx"))
+        trace_tok = tracing.set_current(trace_ctx)
         try:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
@@ -725,6 +744,7 @@ class Worker:
                 "error": self._encode_error(msg.get("name", "task"), e),
             }
         finally:
+            tracing.reset(trace_tok)
             for oid in pinned:
                 self.store.release(oid)
         # drop the frame's refs BEFORE computing the borrow table: only
@@ -733,7 +753,8 @@ class Worker:
         # through the head every task
         args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
-            f"task::{msg.get('name', 'task')}", t0)
+            f"task::{msg.get('name', 'task')}", t0,
+            trace=trace_ctx, task_id=task_id)
         # worker-side lifecycle stamps ride the reply; the owner merges
         # them into the task's transition record (task_events analog)
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
@@ -744,15 +765,20 @@ class Worker:
         reply.update(self.proxy.ref_tables())
         self.sender.send(reply)
 
-    def _profile_batch(self, span_name: str, t0: float) -> List[dict]:
+    def _profile_batch(self, span_name: str, t0: float,
+                       trace=None, task_id=None) -> List[dict]:
         """Record this task's execution span and flush buffered user
         profile() events — the worker→GCS ProfileEvent batch path
-        (src/ray/core_worker/profiling.h:30) riding the done reply."""
+        (src/ray/core_worker/profiling.h:30) riding the done reply.
+        ``trace`` carries the task's (trace_id, span_id, parent) so the
+        exec slice joins the head-side lifecycle slices' flow group."""
         from ..utils import timeline
 
         timeline.record_event(
             span_name, "task", t0, time.time(),
             pid=f"worker:{self.worker_id.hex()[:8]}",
+            extra={"task_id": task_id.hex()} if task_id else None,
+            trace=trace,
         )
         # amortized: most replies carry no profile; every ~64th (or 1s)
         # carries the batch — stragglers ship via _profile_flush_loop
@@ -856,6 +882,8 @@ class Worker:
             return
         pinned: List[bytes] = []
         t0 = time.time()
+        trace_ctx = tracing.from_wire(msg.get("trace_ctx"))
+        trace_tok = tracing.set_current(trace_ctx)
         try:
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             if inspect.iscoroutinefunction(method):
@@ -867,9 +895,18 @@ class Worker:
                 # loop thread) sends the reply and releases pinned args.
                 loop = state.ensure_loop()
 
-                async def _bounded(m=method, a=args, kw=kwargs, s=state):
-                    async with s.async_sem:
-                        return await m(*a, **kw)
+                async def _bounded(m=method, a=args, kw=kwargs, s=state,
+                                   tc=trace_ctx):
+                    # run_coroutine_threadsafe does NOT inherit this
+                    # dispatcher thread's contextvars — the trace context
+                    # must be installed INSIDE the coroutine for nested
+                    # submits awaited by the method body to chain
+                    tok = tracing.set_current(tc)
+                    try:
+                        async with s.async_sem:
+                            return await m(*a, **kw)
+                    finally:
+                        tracing.reset(tok)
 
                 fut = asyncio.run_coroutine_threadsafe(_bounded(), loop)
                 fut.add_done_callback(
@@ -887,13 +924,16 @@ class Worker:
         except BaseException as e:  # noqa: BLE001
             reply = {"type": "done", "task_id": task_id, "returns": [],
                      "error": self._encode_error(msg["method"], e)}
+        finally:
+            tracing.reset(trace_tok)
         for oid in pinned:
             self.store.release(oid)
         # only refs retained in actor/user state survive this drop and
         # count as borrows (see exec_task)
         args = kwargs = result = returns = None  # noqa: F841
         reply["profile"] = self._profile_batch(
-            f"actor::{msg.get('name', msg['method'])}", t0)
+            f"actor::{msg.get('name', msg['method'])}", t0,
+            trace=trace_ctx, task_id=task_id)
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
@@ -929,7 +969,8 @@ class Worker:
             pass
         fut = None  # noqa: F841
         reply["profile"] = self._profile_batch(
-            f"actor::{msg.get('name', msg['method'])}", t0)
+            f"actor::{msg.get('name', msg['method'])}", t0,
+            trace=tracing.from_wire(msg.get("trace_ctx")), task_id=task_id)
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along

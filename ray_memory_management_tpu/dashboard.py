@@ -15,7 +15,9 @@ Routes::
     /api/drivers            GCS job table (driver + client jobs)
     /api/events             structured cluster events
     /api/task_summary       task-state counts + per-stage latency p50/95/99
-    /api/timeline           Chrome traceEvents JSON (load in Perfetto)
+    /api/timeline           Chrome traceEvents JSON (load in Perfetto);
+                            filters: ?task_id=&trace_id=&cat=&limit=
+    /api/trace?trace_id=    span tree + critical-path attribution
     /metrics                Prometheus exposition text
 """
 
@@ -84,9 +86,14 @@ class Dashboard:
         return f"http://{self.host}:{self.port}"
 
     def _route(self, path: str):
+        from urllib.parse import parse_qs, urlsplit
+
         from . import state
 
-        path = path.split("?")[0].rstrip("/") or "/"
+        parts = urlsplit(path)
+        # first value per key: these routes take scalar filters only
+        query = {k: v[0] for k, v in parse_qs(parts.query).items() if v}
+        path = parts.path.rstrip("/") or "/"
         if path == "/":
             return 200, "text/html", _HTML.encode()
         if path == "/metrics":
@@ -135,7 +142,31 @@ class Dashboard:
         elif path == "/api/timeline":
             from .utils import timeline as _timeline
 
-            data = _timeline.chrome_trace_events()
+            limit = None
+            if "limit" in query:
+                try:
+                    limit = max(0, int(query["limit"]))
+                except ValueError:
+                    limit = None
+            data = {
+                "traceEvents": _timeline.chrome_trace_events(
+                    task_id=query.get("task_id"),
+                    trace_id=query.get("trace_id"),
+                    cat=query.get("cat"),
+                    limit=limit),
+                # ring evictions since start/clear: a non-zero value
+                # warns that the export is a suffix, not the full run
+                "dropped": _timeline.dropped_count(),
+            }
+        elif path == "/api/trace":
+            trace_id = query.get("trace_id")
+            if not trace_id:
+                return (400, "application/json",
+                        b'{"error": "trace_id query param required"}')
+            data = {
+                "trace": state.get_trace(trace_id),
+                "critical_path": state.summarize_critical_path(trace_id),
+            }
         else:
             return 404, "application/json", b'{"error": "not found"}'
         return 200, "application/json", json.dumps(data).encode()

@@ -176,3 +176,17 @@ class ObjectID(BaseID):
 
     def return_index(self) -> int:
         return int.from_bytes(self._binary[_ID_SIZE - _INDEX_BYTES :], "little")
+
+
+# Trace-plane identifiers: plain hex strings rather than BaseID — they only
+# ever travel inside timeline args / wire-message dicts, never key runtime
+# tables, so the typed-wrapper machinery would be pure overhead on the
+# submit hot path. 128-bit trace ids (collision-free per cluster lifetime),
+# 64-bit span ids (per-trace scope), both from the buffered entropy pool.
+
+def new_trace_id() -> str:
+    return _entropy.take(_ID_SIZE).hex()
+
+
+def new_span_id() -> str:
+    return _entropy.take(8).hex()

@@ -119,6 +119,37 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Span tree + critical-path attribution for one trace of the
+    runtime in THIS process (like ``summary``/``memory``, reads the
+    in-process runtime — call main(['trace', ...]) from a driver). With
+    no trace_id, lists the indexed trace ids newest-last."""
+    from ray_memory_management_tpu import _worker_context, state
+
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['trace']))",
+              file=sys.stderr)
+        return 1
+    if not args.trace_id:
+        with rt._lock:
+            trace_ids = list(rt._traces)
+        print(json.dumps({"traces": trace_ids}, indent=2))
+        return 0
+    data = {
+        "trace": state.get_trace(args.trace_id),
+        "critical_path": state.summarize_critical_path(args.trace_id),
+    }
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(data, f, indent=2)
+        print(f"trace written to {args.output}")
+    else:
+        print(json.dumps(data, indent=2))
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -268,6 +299,15 @@ def build_parser() -> argparse.ArgumentParser:
         "summary",
         help="task-state counts + per-stage latency p50/p95/p99")
     s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser(
+        "trace",
+        help="span tree + critical-path breakdown for one trace "
+             "(no trace_id: list known trace ids)")
+    s.add_argument("trace_id", nargs="?", default=None)
+    s.add_argument("--output", default=None,
+                   help="write JSON here instead of stdout")
+    s.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("microbenchmark",
                        help="run the core microbenchmark suite")

@@ -6,6 +6,7 @@ plus the GCS global-state reads in ray._private.state.
 """
 
 from .api import (  # noqa: F401
+    get_trace,
     list_actors,
     list_cluster_events,
     list_jobs,
@@ -15,6 +16,7 @@ from .api import (  # noqa: F401
     list_tasks,
     list_workers,
     summarize_actors,
+    summarize_critical_path,
     summarize_objects,
     summarize_task_latencies,
     summarize_tasks,

@@ -94,8 +94,13 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
         "num_returns": nret, "retries_left": retries,
         "is_actor_task": is_actor,
         "durations": stage_durations(ts),
-    } for tid, name, state, nret, retries, is_actor, ts in history]
+        "trace_id": trace_ctx[0] if trace_ctx else None,
+        "span_id": trace_ctx[1] if trace_ctx else None,
+        "parent_span_id": trace_ctx[2] if trace_ctx else None,
+    } for tid, name, state, nret, retries, is_actor, ts, trace_ctx
+        in history]
     for task_id, rec in records:
+        tctx = rec.spec.trace_ctx
         rows.append({
             "task_id": task_id.hex(),
             "name": rec.spec.name,
@@ -104,6 +109,9 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "retries_left": rec.retries_left,
             "is_actor_task": rec.spec.is_actor_task,
             "durations": stage_durations(rec.ts),
+            "trace_id": tctx[0] if tctx else None,
+            "span_id": tctx[1] if tctx else None,
+            "parent_span_id": tctx[2] if tctx else None,
         })
     return _apply_filters(rows, filters)[:limit]
 
@@ -121,30 +129,33 @@ def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "node_id": None,
         })
     with rt.gcs._lock:
-        locations = {oid: list(nodes) for oid, nodes
-                     in rt.gcs.object_locations.items()}
-    for oid, nodes in locations.items():
-        for node_id in nodes:
-            with rt._lock:
-                nm = rt.nodes.get(node_id)
-            size = None
-            where = "store"
-            if nm is not None and nm.alive:
-                try:
-                    # read shm directly: store.get() would RESTORE spilled
-                    # objects (disk read + shm fill) just to measure them
-                    view = nm.store.shm.get(oid)
-                    if view is not None:
-                        size = view.nbytes
-                        nm.store.shm.release(oid)
-                    elif nm.store.contains(oid):
-                        where = "spilled"
-                except Exception:
-                    size = None
+        oids = list(rt.gcs.object_locations)
+    # one batched directory read replaces the old per-(object, node) shm
+    # get/release round-trips — for remote stores each of those was an
+    # IPC, making the listing O(objects * nodes) remote calls
+    located = rt.gcs.locate_objects(oids)
+    with rt._lock:
+        node_managers = dict(rt.nodes)
+    # spill metadata is only visible for in-process stores; a remote
+    # node's spilled set would cost the very round-trips we're avoiding
+    spilled_by_node: Dict[Any, set] = {}
+    for node_id, nm in node_managers.items():
+        store = getattr(nm, "store", None)
+        lock = getattr(store, "_spill_lock", None)
+        if lock is None:
+            continue
+        try:
+            with lock:
+                spilled_by_node[node_id] = set(store._spilled)
+        except Exception:
+            continue
+    for oid, (size, holders) in located.items():
+        for node_id in holders:
+            spilled = spilled_by_node.get(node_id, ())
             rows.append({
                 "object_id": oid.hex(),
-                "size_bytes": size,
-                "where": where,
+                "size_bytes": size or None,
+                "where": "spilled" if oid in spilled else "store",
                 "node_id": node_id.hex(),
             })
     return _apply_filters(rows, filters)[:limit]
@@ -230,6 +241,149 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     idx = max(0, min(len(sorted_vals) - 1,
                      int(round(q * (len(sorted_vals) - 1)))))
     return sorted_vals[idx]
+
+
+# --------------------------------------------------------------- tracing
+def _trace_task_rows(trace_id: str) -> List[Dict[str, Any]]:
+    """All tasks indexed under one trace, from live records first and the
+    bounded history for anything already pruned. Rows keep the raw
+    transition-stamp dict (``ts``) so the critical-path sweep can build
+    intervals without re-deriving them from durations."""
+    rt = _runtime()
+    with rt._lock:
+        task_ids = list(rt._traces.get(trace_id, ()))
+        found: Dict[bytes, tuple] = {}
+        for tid in task_ids:
+            rec = rt.tasks.get(tid)
+            if rec is not None:
+                found[tid] = (rec.spec.name, rec.state,
+                              rec.spec.trace_ctx, dict(rec.ts))
+        missing = [t for t in task_ids if t not in found]
+        history = list(rt.task_history) if missing else []
+    if missing:
+        want = set(missing)
+        for tid, name, state, _n, _r, _a, ts, tctx in history:
+            if tid in want and tctx:
+                found[tid] = (name, state, tctx, dict(ts))
+    rows = []
+    for tid in task_ids:
+        got = found.get(tid)
+        if got is None:
+            continue
+        name, state, tctx, ts = got
+        rows.append({
+            "task_id": tid.hex(),
+            "name": name,
+            "state": state,
+            "span_id": tctx[1] if tctx else None,
+            "parent_span_id": tctx[2] if tctx else None,
+            "ts": ts,
+        })
+    return rows
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """Span tree for one trace: every task whose submit minted a span
+    under ``trace_id``, linked parent→child the way nested ``.remote()``
+    calls chained their contexts. ``roots``/``children`` reference spans
+    by span_id (flat ``spans`` list holds the payload), so the result
+    JSON-serializes without recursion."""
+    from ..core.runtime import stage_durations
+
+    rows = _trace_task_rows(trace_id)
+    spans = []
+    for r in rows:
+        ts = r["ts"]
+        stamps = [v for v in ts.values() if v is not None]
+        spans.append({
+            "span_id": r["span_id"],
+            "parent_span_id": r["parent_span_id"],
+            "task_id": r["task_id"],
+            "name": r["name"],
+            "state": r["state"],
+            "start_ts": min(stamps) if stamps else None,
+            "end_ts": max(stamps) if stamps else None,
+            "durations": stage_durations(ts),
+            "children": [],
+        })
+    by_span = {s["span_id"]: s for s in spans if s["span_id"]}
+    roots = []
+    for s in spans:
+        parent = s["parent_span_id"]
+        if parent and parent in by_span:
+            by_span[parent]["children"].append(s["span_id"])
+        else:
+            roots.append(s["span_id"])
+    return {"trace_id": trace_id, "num_spans": len(spans),
+            "roots": roots, "spans": spans}
+
+
+# Critical-path attribution: stage -> transition-stamp intervals, listed
+# in PRIORITY order. A wall-clock instant covered by several overlapping
+# intervals (a sibling executing while another waits in queue) is charged
+# to the highest-priority stage only — exec beats transfer beats queue
+# beats schedule-wait — so the stage seconds sum to at most the wall time
+# and the uncovered remainder is, by construction, runtime overhead.
+_CP_STAGES = (
+    ("exec", (("RUNNING", "WORKER_DONE"),)),
+    ("transfer", (("PREFETCH_START", "PREFETCH_DONE"),
+                  ("WORKER_DONE", "FINISHED"))),
+    ("queue", (("DISPATCHED", "RUNNING"),)),
+    ("schedule_wait", (("SUBMITTED", "SCHEDULED"),)),
+)
+
+
+def summarize_critical_path(trace_id: str) -> Dict[str, Any]:
+    """Attribute a trace's wall time (first submit stamp → last stamp of
+    any of its spans) to named stages via a priority interval sweep.
+    Every second lands somewhere: ``stages`` + ``overhead_s`` equals
+    ``wall_time_s`` exactly; ``coverage`` is the fraction explained by
+    the named (non-overhead) stages."""
+    rows = _trace_task_rows(trace_id)
+    empty = {"trace_id": trace_id, "tasks": len(rows),
+             "wall_time_s": 0.0, "stages": {}, "overhead_s": 0.0,
+             "coverage": 0.0}
+    if not rows:
+        return empty
+    intervals: List[Tuple[float, float, int, str]] = []
+    t_min, t_max = float("inf"), float("-inf")
+    for r in rows:
+        ts = r["ts"]
+        for v in ts.values():
+            if v is not None:
+                t_min = min(t_min, v)
+                t_max = max(t_max, v)
+        for prio, (stage, edges) in enumerate(_CP_STAGES):
+            for a, b in edges:
+                ta, tb = ts.get(a), ts.get(b)
+                if ta is not None and tb is not None and tb > ta:
+                    intervals.append((ta, tb, prio, stage))
+    if t_max <= t_min:
+        return empty
+    wall = t_max - t_min
+    # boundary sweep: between consecutive stamp boundaries exactly one
+    # stage (or none) wins, so each segment is charged exactly once
+    points = sorted({t_min, t_max,
+                     *(p for iv in intervals for p in iv[:2])})
+    stages: Dict[str, float] = {}
+    overhead = 0.0
+    for lo, hi in zip(points, points[1:]):
+        seg = hi - lo
+        if seg <= 0:
+            continue
+        best = None
+        for ta, tb, prio, stage in intervals:
+            if ta <= lo and tb >= hi and (best is None or prio < best[0]):
+                best = (prio, stage)
+        if best is None:
+            overhead += seg
+        else:
+            stages[best[1]] = stages.get(best[1], 0.0) + seg
+    return {"trace_id": trace_id, "tasks": len(rows),
+            "wall_time_s": wall,
+            "stages": stages,
+            "overhead_s": overhead,
+            "coverage": (wall - overhead) / wall}
 
 
 def summarize_task_latencies() -> Dict[str, Dict[str, float]]:

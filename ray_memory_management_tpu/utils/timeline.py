@@ -31,14 +31,43 @@ MAX_EVENTS = 200_000
 
 _lock = threading.Lock()
 _events: deque = deque(maxlen=MAX_EVENTS)
-_enabled = True
+# RMT_TIMELINE=0 disables span recording process-wide; workers and node
+# agents inherit the driver's environment, so exporting it before init()
+# turns the whole trace plane off (how the overhead bench gets its
+# baseline)
+_enabled = os.environ.get("RMT_TIMELINE", "1").lower() not in (
+    "0", "false", "off")
+_dropped = 0  # ring evictions in THIS process (oldest-first, silent before)
+
+
+def _count_drops_locked(n: int) -> None:
+    """Account ring evictions: the local counter feeds the /api/timeline
+    ``dropped`` field; the metric merges worker/agent-side drops into the
+    head registry via the ordinary delta-flush channel."""
+    global _dropped
+    _dropped += n
+    try:
+        from ..core import metrics_defs as mdefs
+
+        mdefs.timeline_events_dropped().inc(n)
+    except Exception:  # noqa: BLE001 — metrics registry not importable
+        pass
+
+
+def dropped_count() -> int:
+    with _lock:
+        return _dropped
 
 
 def record_event(name: str, cat: str, start: float, end: float,
                  pid: Any = None, tid: Any = None,
-                 extra: Optional[dict] = None) -> None:
+                 extra: Optional[dict] = None,
+                 trace=None) -> None:
     """Record one complete ("ph":"X") span. Timestamps are time.time()
-    seconds; converted to microseconds at dump time."""
+    seconds; converted to microseconds at dump time. ``trace`` is an
+    optional (trace_id, span_id, parent_span_id) context — its ids land
+    in the span's args, which is what the flow-event synthesis in
+    chrome_trace_events and the /api/timeline filters key on."""
     if not _enabled:
         return
     ev = {
@@ -49,9 +78,19 @@ def record_event(name: str, cat: str, start: float, end: float,
         "pid": pid if pid is not None else f"pid:{os.getpid()}",
         "tid": tid if tid is not None else threading.get_ident(),
     }
-    if extra:
+    if trace:
+        from . import tracing
+
+        targs = tracing.as_args(trace)
+        if targs:
+            ev["args"] = {**targs, **extra} if extra else targs
+        elif extra:
+            ev["args"] = extra
+    elif extra:
         ev["args"] = extra
     with _lock:
+        if len(_events) == MAX_EVENTS:
+            _count_drops_locked(1)
         _events.append(ev)
 
 
@@ -71,8 +110,13 @@ class profile:
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        # user spans inherit whatever trace context is current — inside a
+        # task body that is the executing task's context, so ad-hoc
+        # profile("...") blocks land on the task's causal chain for free
+        from . import tracing
+
         record_event(self._name, self._cat, self._start, time.time(),
-                     extra=self._extra)
+                     extra=self._extra, trace=tracing.get_current())
         return False
 
 
@@ -106,16 +150,21 @@ def drain_events_if_due(min_batch: int = 64,
 
 
 def ingest_events(events: List[dict]) -> None:
-    """Driver-side: merge a batch shipped from a worker."""
+    """Driver-side: merge a batch shipped from a worker or agent."""
     if not events:
         return
     with _lock:
+        overflow = len(_events) + len(events) - MAX_EVENTS
+        if overflow > 0:
+            _count_drops_locked(min(overflow, MAX_EVENTS))
         _events.extend(events)
 
 
 def clear() -> None:
+    global _dropped
     with _lock:
         _events.clear()
+        _dropped = 0
 
 
 def set_enabled(flag: bool) -> None:
@@ -123,13 +172,93 @@ def set_enabled(flag: bool) -> None:
     _enabled = flag
 
 
-def chrome_trace_events() -> List[dict]:
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _synthesize_flows(slices: List[dict]) -> List[dict]:
+    """Chrome flow events ("ph":"s"/"t"/"f") linking the slices of each
+    span across processes, plus parent→child arrows.
+
+    Grouping: every slice carrying args.trace_id+span_id belongs to that
+    span's flow (one task's submit/schedule/dispatch stage slices on the
+    head, its exec slice in the worker, all share the task's span_id).
+    Within a group, slices sorted by ts become s → t… → f steps, each
+    step anchored at its slice's (pid, tid, ts) so Perfetto binds the
+    arrow to the enclosing slice ("bp":"e" on the terminator).
+
+    Parent chaining: a group whose parent_span_id names another group in
+    the dump gets its flow STARTED on the parent's latest slice that
+    begins at-or-before the child's first — drawing submit→nested-submit
+    and task→transfer arrows. Flows with fewer than two steps are not
+    emitted (an unpaired "s" renders as a dangling arrow stub)."""
+    groups: dict = {}
+    for entry in slices:
+        args = entry.get("args")
+        if not args:
+            continue
+        t, s = args.get("trace_id"), args.get("span_id")
+        if not t or not s:
+            continue
+        groups.setdefault((t, s), []).append(entry)
+    for anchors in groups.values():
+        anchors.sort(key=lambda e: e["ts"])
+    flows: List[dict] = []
+    for (trace_id, span_id), anchors in groups.items():
+        steps = list(anchors)
+        parent = anchors[0].get("args", {}).get("parent_span_id")
+        if parent and (trace_id, parent) in groups:
+            first_ts = anchors[0]["ts"]
+            panchors = groups[(trace_id, parent)]
+            anchor = panchors[0]
+            for cand in panchors:
+                if cand["ts"] <= first_ts:
+                    anchor = cand
+                else:
+                    break
+            steps = [anchor] + steps
+        if len(steps) < 2:
+            continue
+        for i, step in enumerate(steps):
+            ph = "s" if i == 0 else ("f" if i == len(steps) - 1 else "t")
+            flow = {
+                "name": "trace", "cat": "trace", "ph": ph,
+                "id": span_id, "ts": step["ts"],
+                "pid": step["pid"], "tid": step["tid"],
+            }
+            if ph == "f":
+                flow["bp"] = "e"
+            flows.append(flow)
+    return flows
+
+
+def chrome_trace_events(task_id: Optional[str] = None,
+                        trace_id: Optional[str] = None,
+                        cat: Optional[str] = None,
+                        limit: Optional[int] = None,
+                        flows: bool = True) -> List[dict]:
     """Render collected events as Chrome trace 'X' events (the
-    chrome_tracing_dump format, _private/state.py:413)."""
+    chrome_tracing_dump format, _private/state.py:413) plus synthesized
+    flow events linking each trace's spans across processes.
+
+    Filters are ANDed server-side (the /api/timeline query params):
+    ``task_id`` matches args.task_id, ``trace_id`` matches args.trace_id,
+    ``cat`` the event category; ``limit`` keeps the NEWEST n slices
+    (flow synthesis runs after filtering so arrows never reference
+    slices the filter removed)."""
     with _lock:
         evs = list(_events)
     out = []
     for ev in evs:
+        args = ev.get("args")
+        if cat is not None and ev.get("cat", "user") != cat:
+            continue
+        if trace_id is not None and (
+                not args or args.get("trace_id") != trace_id):
+            continue
+        if task_id is not None and (
+                not args or args.get("task_id") != task_id):
+            continue
         entry = {
             "name": ev["name"],
             "cat": ev.get("cat", "user"),
@@ -139,9 +268,14 @@ def chrome_trace_events() -> List[dict]:
             "pid": ev.get("pid", 0),
             "tid": ev.get("tid", 0),
         }
-        if "args" in ev:
-            entry["args"] = ev["args"]
+        if args:
+            entry["args"] = args
         out.append(entry)
+    if limit is not None and limit >= 0 and len(out) > limit:
+        out.sort(key=lambda e: e["ts"])
+        out = out[-limit:] if limit else []  # [-0:] is the full list
+    if flows:
+        out.extend(_synthesize_flows(out))
     return out
 
 

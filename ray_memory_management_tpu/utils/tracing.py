@@ -1,0 +1,89 @@
+"""Causal trace context: Dapper-style (trace_id, span_id, parent) tuples.
+
+The reference has no cross-process causality — per-worker ProfileEvents
+land in one timeline file with nothing linking a submit to its dispatch,
+exec, or the transfers it caused (profiling.h:30). This module is the
+propagation half of the trace plane: a context is minted at every
+top-level ``.remote()`` submit (runtime.submit_task), rides ``TaskSpec``
+and every wire message the task causes, and is re-installed around
+execution in the worker so nested submits inherit it.
+
+A context is a plain tuple ``(trace_id, span_id, parent_span_id)`` of
+hex strings (parent may be None) — tuples pickle cheaply on the dispatch
+hot path and need no class on the receiving end.
+
+Propagation uses a ContextVar: thread-local by default (each worker
+executor thread carries its own task's context) and explicitly
+re-installed inside async actor coroutines, because
+``run_coroutine_threadsafe`` does NOT inherit the submitting thread's
+context (the dispatcher thread's var never reaches the loop thread).
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional, Tuple
+
+TraceContext = Tuple[str, str, Optional[str]]
+
+_current: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("rmt_trace_ctx", default=None)
+
+
+def new_root() -> TraceContext:
+    """Mint a fresh root context (a new trace)."""
+    from ..ids import new_span_id, new_trace_id
+
+    return (new_trace_id(), new_span_id(), None)
+
+
+def child_of(parent: Optional[TraceContext]) -> TraceContext:
+    """Mint a child span of ``parent`` (same trace), or a new root when
+    there is no parent — the one call sites use so top-level and nested
+    submits share a code path."""
+    if not parent:
+        return new_root()
+    from ..ids import new_span_id
+
+    return (parent[0], new_span_id(), parent[1])
+
+
+def get_current() -> Optional[TraceContext]:
+    return _current.get()
+
+
+def set_current(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as the current context; returns the reset token."""
+    return _current.set(ctx)
+
+
+def reset(token) -> None:
+    try:
+        _current.reset(token)
+    except Exception:  # noqa: BLE001 — token from another context
+        _current.set(None)
+
+
+def as_args(ctx: Optional[TraceContext]) -> Optional[dict]:
+    """Render a context as timeline-span args (the keys the flow-event
+    synthesis in timeline.chrome_trace_events groups by)."""
+    if not ctx:
+        return None
+    out = {"trace_id": ctx[0], "span_id": ctx[1]}
+    if ctx[2]:
+        out["parent_span_id"] = ctx[2]
+    return out
+
+
+def from_wire(raw) -> Optional[TraceContext]:
+    """Validate a context that arrived on a wire message (list after
+    msgpack/json round trips; garbage from a bad peer must not throw)."""
+    try:
+        if not raw or isinstance(raw, (str, bytes)) or len(raw) != 3:
+            return None
+        t, s, p = raw[0], raw[1], raw[2]
+        if not (isinstance(t, str) and isinstance(s, str)):
+            return None
+        return (t, s, p if isinstance(p, str) else None)
+    except Exception:  # noqa: BLE001
+        return None

@@ -209,6 +209,49 @@ def test_headline_line_carries_locality_summary(bench):
         assert line["locality"]["prefetch_overlap_ms"] == 11.3
 
 
+def test_tracing_suite_reports_required_fields(bench):
+    """The tracing suite must emit every field the BENCH_DETAIL.json
+    contract names (on/off tasks-per-s, overhead pct) — run a mini-sized
+    pass so CI proves the real code path, not a fixture."""
+    from ray_memory_management_tpu.utils.tracing_bench import (
+        run_tracing_suite,
+    )
+
+    out = run_tracing_suite(n_tasks=16, trials=1)
+    missing = [k for k in bench.REQUIRED_TRACING_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["tracing_on_tasks_per_s"] > 0
+    assert out["tracing_off_tasks_per_s"] > 0
+
+
+def test_headline_line_carries_tracing_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    tracing = {"tracing_overhead_pct": 2.4}
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, None, None, tracing)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "tracing" in line:  # may be popped only by the <1KB guard
+        assert line["tracing"]["overhead_pct"] == 2.4
+
+
+def test_bench_detail_snapshot_has_tracing_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the tracing section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    tracing = detail.get("tracing")
+    if tracing is None:
+        pytest.skip("snapshot predates the tracing section")
+    if "error" not in tracing:
+        missing = [k for k in bench.REQUIRED_TRACING_FIELDS
+                   if k not in tracing]
+        assert not missing, missing
+
+
 def test_bench_detail_snapshot_has_locality_section(bench):
     """An existing BENCH_DETAIL.json snapshot (written by a full bench
     run) must carry the locality section with the required fields."""

@@ -305,7 +305,9 @@ class TestAcceptanceWorkload:
             summary = json.loads(body)
             assert set(summary["latencies"]) == set(lat)
             status, _, body = dash._route("/api/timeline")
-            assert status == 200 and isinstance(json.loads(body), list)
+            tl = json.loads(body)
+            assert status == 200 and isinstance(tl["traceEvents"], list)
+            assert isinstance(tl["dropped"], int)
             status, _, body = dash._route("/metrics")
             assert status == 200 and b"rmt_tasks_submitted_total" in body
         finally:
