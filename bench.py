@@ -385,6 +385,47 @@ def _tracing_suite():
         return {"error": repr(e)}
 
 
+# Elastic-training contract surfaced in BENCH_DETAIL.json
+# (tests/test_bench_format.py enforces the set): steps/s with durability
+# off/sync/async, the step-blocking slice of one save in each mode (the
+# ISSUE caps async at < 10% of sync), and the wall-clock cost of one
+# injected worker kill mid-run.
+REQUIRED_ELASTIC_FIELDS = (
+    "steps_per_s_ckpt_off", "steps_per_s_ckpt_sync",
+    "steps_per_s_ckpt_async", "blocking_ms_sync", "blocking_ms_async",
+    "async_blocking_vs_sync_pct", "recovery_s", "n_steps",
+    "checkpoint_every",
+)
+
+
+def _elastic_suite():
+    """Elastic-training cost/recovery (utils/train_elastic_bench.py);
+    fault-isolated so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.train_elastic_bench import (
+            run_elastic_suite,
+        )
+
+        out = run_elastic_suite()
+        print(
+            f"  elastic train ({out['n_steps']} steps): "
+            f"{out['steps_per_s_ckpt_off']:.1f} steps/s off, "
+            f"{out['steps_per_s_ckpt_sync']:.1f} sync, "
+            f"{out['steps_per_s_ckpt_async']:.1f} async; blocking "
+            f"{out['blocking_ms_async']:.2f} vs "
+            f"{out['blocking_ms_sync']:.2f} ms "
+            f"({out['async_blocking_vs_sync_pct']:.1f}% of sync); "
+            f"kill recovery {out['recovery_s']:.2f}s",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_ELASTIC_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  elastic suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -504,6 +545,7 @@ def main() -> None:
     transfer = _transfer_suite()
     locality = _locality_suite()
     tracing = _tracing_suite()
+    elastic = _elastic_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
 
@@ -513,7 +555,8 @@ def main() -> None:
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
               "transfer": transfer, "locality": locality,
-              "tracing": tracing, "metrics": obs_metrics}
+              "tracing": tracing, "elastic": elastic,
+              "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -523,17 +566,18 @@ def main() -> None:
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "tpu", "transfer", "locality",
-                    "tracing", "metrics"):
+                    "tracing", "elastic", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
-                        tpu, transfer, locality, tracing))
+                        tpu, transfer, locality, tracing, elastic))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
-                  transfer=None, locality=None, tracing=None):
+                  transfer=None, locality=None, tracing=None,
+                  elastic=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -582,6 +626,13 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
         line["tracing"] = {
             "overhead_pct": tracing["tracing_overhead_pct"],
         }
+    if elastic and "error" not in elastic:
+        # the elastic-training acceptance numbers: async step-blocking
+        # cost (< 10% of sync) and kill-recovery wall-clock
+        line["elastic"] = {
+            "async_vs_sync_pct": elastic["async_blocking_vs_sync_pct"],
+            "recovery_s": elastic["recovery_s"],
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -604,7 +655,8 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("tracing", "locality", "transfer", "micro", "scale"):
+        for k in ("elastic", "tracing", "locality", "transfer", "micro",
+                  "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

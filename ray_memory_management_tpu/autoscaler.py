@@ -18,6 +18,29 @@ from typing import Any, Dict, List, Optional
 
 from . import _worker_context
 
+# -- explicit demand (autoscaler sdk request_resources analog) ----------------
+# The elastic trainer pins a demand floor here so the Monitor replaces a
+# dead training node even while no tasks are queued (a gang that lost a
+# member holds its survivors and queues NOTHING — invisible to the
+# pending/backlog signals below).
+_request_mu = threading.Lock()
+_requested_bundles: List[Dict[str, float]] = []
+
+
+def request_resources(bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> None:
+    """Pin a resource-demand floor (ray.autoscaler.sdk.request_resources
+    analog): the autoscaler scales until the cluster's TOTAL capacity can
+    hold every requested bundle. Replaces any previous request; ``None``
+    or ``[]`` clears it."""
+    with _request_mu:
+        _requested_bundles[:] = [dict(b) for b in (bundles or [])]
+
+
+def requested_bundles() -> List[Dict[str, float]]:
+    with _request_mu:
+        return [dict(b) for b in _requested_bundles]
+
 
 class NodeProvider:
     """Provider contract (autoscaler/node_provider.py): create/terminate
@@ -121,7 +144,29 @@ class StandardAutoscaler:
             pending = len(rt._pending_schedule)
             node_managers = list(rt.nodes.values())
         backlog = sum(nm.backlog() for nm in node_managers if nm.alive)
-        return pending + backlog
+        return pending + backlog + self._unmet_requests(node_managers)
+
+    def _unmet_requests(self, node_managers) -> int:
+        """Requested bundles (request_resources) that the cluster's TOTAL
+        capacity cannot hold — charged against totals, not availability,
+        so a running gang does not read as perpetual demand."""
+        req = requested_bundles()
+        if not req:
+            return 0
+        from .core.resources import Resources
+
+        totals = [Resources.from_fixed(nm.resources.total.fixed())
+                  for nm in node_managers if nm.alive]
+        unmet = 0
+        for b in req:
+            r = Resources(b)
+            for i, free in enumerate(totals):
+                if r.fits_in(free):
+                    totals[i] = free - r
+                    break
+            else:
+                unmet += 1
+        return unmet
 
     def _node_busy(self, node_id) -> bool:
         nm = self._rt.nodes.get(node_id)

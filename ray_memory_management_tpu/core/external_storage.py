@@ -25,6 +25,31 @@ class ExternalStorage:
     def delete(self, url: str) -> None:
         raise NotImplementedError
 
+    # -- named-blob surface (checkpoint/artifact IO) --------------------------
+    # The spill surface above is keyed by opaque object ids; checkpoints
+    # need NAMED keys under a caller-chosen prefix (the reference reuses
+    # smart_open for both — here the same backend object serves both
+    # surfaces so s3://gs:// IO code lives in exactly one place).
+    def put_blob(self, url: str, data: bytes) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support named blobs")
+
+    def get_blob(self, url: str) -> bytes:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support named blobs")
+
+    def list_blobs(self, url_prefix: str) -> List[str]:
+        """Full URLs of blobs under the prefix (recursive)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support named blobs")
+
+    def delete_prefix(self, url_prefix: str) -> None:
+        for url in self.list_blobs(url_prefix):
+            try:
+                self.delete(url)
+            except Exception:  # noqa: BLE001 - best-effort GC
+                pass
+
     def probe(self) -> bool:
         """Write-and-delete a tiny sentinel object; True when the backend
         is usable. The store's spill-degraded mode calls this to decide
@@ -64,6 +89,67 @@ class FileSystemStorage(ExternalStorage):
             os.remove(url)
         except FileNotFoundError:
             pass
+
+    @staticmethod
+    def _path_of(url: str) -> str:
+        return url[len("file://"):] if url.startswith("file://") else url
+
+    def put_blob(self, url: str, data: bytes) -> None:
+        path = self._path_of(url)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_blob(self, url: str) -> bytes:
+        with open(self._path_of(url), "rb") as f:
+            return f.read()
+
+    def list_blobs(self, url_prefix: str) -> List[str]:
+        root = self._path_of(url_prefix)
+        out: List[str] = []
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                out.append(os.path.join(dirpath, name))
+        return sorted(out)
+
+
+class InMemoryStorage(ExternalStorage):
+    """Blob/spill storage backed by a dict — the test double for cloud
+    tiers: register it under any scheme with ``register_storage_scheme``
+    and the full Checkpoint.to_uri/from_uri path runs without an SDK or a
+    network. All instances constructed for the same uri share one bucket
+    dict, matching real object-store semantics (two clients, one
+    bucket)."""
+
+    _buckets: Dict[str, Dict[str, bytes]] = {}
+
+    def __init__(self, uri: str = "mem://test"):
+        self.uri = uri.rstrip("/")
+        root = self.uri.split("://", 1)[-1].split("/", 1)[0]
+        self._blobs = self._buckets.setdefault(root, {})
+
+    def spill(self, object_id: bytes, data: memoryview) -> str:
+        url = f"{self.uri}/{object_id.hex()}"
+        self._blobs[url] = bytes(data)
+        return url
+
+    def restore(self, object_id: bytes, url: str) -> bytes:
+        return self._blobs[url]
+
+    def delete(self, url: str) -> None:
+        self._blobs.pop(url, None)
+
+    def put_blob(self, url: str, data: bytes) -> None:
+        self._blobs[url] = bytes(data)
+
+    def get_blob(self, url: str) -> bytes:
+        return self._blobs[url]
+
+    def list_blobs(self, url_prefix: str) -> List[str]:
+        pfx = url_prefix.rstrip("/") + "/"
+        return sorted(u for u in self._blobs if u.startswith(pfx))
 
 
 class CloudStorage(ExternalStorage):
@@ -129,6 +215,50 @@ class CloudStorage(ExternalStorage):
                 self._client.bucket(self.bucket).blob(key).delete()
         except Exception:
             pass
+
+    def _url_key(self, url: str) -> str:
+        """bucket-relative key of a full ``scheme://bucket/key`` url."""
+        rest = url.split("://", 1)[1]
+        _bucket, _, key = rest.partition("/")
+        return key
+
+    def put_blob(self, url: str, data: bytes) -> None:
+        key = self._url_key(url)
+        if self._kind == "s3":
+            self._client.put_object(Bucket=self.bucket, Key=key,
+                                    Body=bytes(data))
+        else:
+            self._client.bucket(self.bucket).blob(key).upload_from_string(
+                bytes(data))
+
+    def get_blob(self, url: str) -> bytes:
+        key = self._url_key(url)
+        if self._kind == "s3":
+            return self._client.get_object(
+                Bucket=self.bucket, Key=key)["Body"].read()
+        return self._client.bucket(self.bucket).blob(key).download_as_bytes()
+
+    def list_blobs(self, url_prefix: str) -> List[str]:
+        pfx = self._url_key(url_prefix.rstrip("/")) + "/"
+        scheme = self.uri.split("://", 1)[0]
+        out: List[str] = []
+        if self._kind == "s3":
+            token = None
+            while True:
+                kw = dict(Bucket=self.bucket, Prefix=pfx)
+                if token:
+                    kw["ContinuationToken"] = token
+                resp = self._client.list_objects_v2(**kw)
+                out.extend(f"{scheme}://{self.bucket}/{row['Key']}"
+                           for row in resp.get("Contents", []))
+                if not resp.get("IsTruncated"):
+                    break
+                token = resp.get("NextContinuationToken")
+        else:
+            for blob in self._client.bucket(self.bucket).list_blobs(
+                    prefix=pfx):
+                out.append(f"{scheme}://{self.bucket}/{blob.name}")
+        return sorted(out)
 
 
 # scheme -> factory(uri) registry; third-party tiers plug in the way the

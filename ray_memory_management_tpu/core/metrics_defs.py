@@ -143,6 +143,29 @@ DEFS: Dict[str, tuple] = {
     "rmt_object_directory_prunes_total": (Counter, dict(
         description="Stale GCS object-directory locations pruned after a "
                     "holder reported the object missing.")),
+    # elastic train plane (checkpoint/restore/resize — the preemption-
+    # tolerance instrument set: a training run's durability overhead and
+    # recovery behavior are countable, not just visible in wall-clock)
+    "rmt_train_checkpoint_saves_total": (Counter, dict(
+        description="Durable training checkpoints written (atomic "
+                    "tmp+rename with CRC32 manifest), by result.",
+        tag_keys=("result",))),
+    "rmt_train_checkpoint_restores_total": (Counter, dict(
+        description="Training checkpoints loaded for resume, by source "
+                    "(latest, fallback after a corrupt/partial newest, "
+                    "uri).",
+        tag_keys=("source",))),
+    "rmt_train_checkpoint_save_seconds": (Histogram, dict(
+        description="Training checkpoint save time split by phase: "
+                    "'blocking' is the step-blocking slice the trainer "
+                    "waits on (enqueue/snapshot), 'drain' is the "
+                    "background writer's full durable-write time.",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("phase",))),
+    "rmt_train_elastic_resizes_total": (Counter, dict(
+        description="Elastic worker-group resizes (rebuild at a new "
+                    "world size), by direction (down after node loss, "
+                    "up when capacity returned).",
+        tag_keys=("direction",))),
     # collectives
     "rmt_collective_latency_seconds": (Histogram, dict(
         description="Wall time per collective op.",
@@ -316,6 +339,22 @@ def stale_creates_aborted() -> Counter:
 
 def object_directory_prunes() -> Counter:
     return get("rmt_object_directory_prunes_total")
+
+
+def train_checkpoint_saves() -> Counter:
+    return get("rmt_train_checkpoint_saves_total")
+
+
+def train_checkpoint_restores() -> Counter:
+    return get("rmt_train_checkpoint_restores_total")
+
+
+def train_checkpoint_save_seconds() -> Histogram:
+    return get("rmt_train_checkpoint_save_seconds")
+
+
+def train_elastic_resizes() -> Counter:
+    return get("rmt_train_elastic_resizes_total")
 
 
 def collective_latency_seconds() -> Histogram:

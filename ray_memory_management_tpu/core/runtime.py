@@ -3754,6 +3754,14 @@ class Runtime:
                     proc.terminate()
                 except Exception:
                     pass
+        # a SIGKILLed agent (chaos, preemption) cannot unlink its shm
+        # store; reclaim any same-host segment whose owning pid is gone
+        try:
+            from ..native import reap_stale_stores
+
+            reap_stale_stores("rmtA_")
+        except Exception:
+            pass
         with self._lock:
             self.memory_store.clear()
         try:

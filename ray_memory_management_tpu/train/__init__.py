@@ -2,9 +2,20 @@
 analog, jax/TPU-native)."""
 
 from . import session  # noqa: F401
-from .backend_executor import BackendExecutor, TrainingFailedError  # noqa: F401
-from .checkpoint import Checkpoint  # noqa: F401
+from .backend_executor import (  # noqa: F401
+    BackendExecutor,
+    ElasticResize,
+    TrainingFailedError,
+    placeable_world_size,
+)
+from .checkpoint import (  # noqa: F401
+    AsyncCheckpointManager,
+    Checkpoint,
+    verify_checkpoint_dir,
+)
 from .trainer import (  # noqa: F401
+    CheckpointConfig,
+    ElasticConfig,
     FailureConfig,
     JaxTrainer,
     Result,

@@ -22,12 +22,52 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
-from ..exceptions import ActorError, RmtError, TaskError, WorkerCrashedError
+from ..exceptions import (ActorError, NodeDeadError, RmtError, TaskError,
+                          WorkerCrashedError)
 from .checkpoint import Checkpoint
 
 
 class TrainingFailedError(RmtError):
     pass
+
+
+class ElasticResize(RmtError):
+    """Raised out of BackendExecutor.run when the elastic world watcher
+    wants a DIFFERENT world size (capacity grew back after a downsize).
+    Not a failure: the trainer rebuilds the group at ``target_world`` and
+    resumes from the latest checkpoint without consuming failure budget."""
+
+    def __init__(self, target_world: int):
+        super().__init__(f"elastic resize to world={target_world}")
+        self.target_world = target_world
+
+
+def placeable_world_size(bundle: Dict[str, Any], cap: int,
+                         runtime=None) -> int:
+    """How many copies of ``bundle`` the cluster can place RIGHT NOW
+    (greedy first-fit over alive nodes' available resources), capped at
+    ``cap``. This is the elastic trainer's sizing signal after a node
+    death — rebuild the gang at whatever the surviving nodes can hold —
+    and its recovery signal once the autoscaler replaces the node."""
+    from .. import _worker_context
+    from ..core.resources import Resources
+
+    rt = runtime or _worker_context.get_runtime()
+    req = Resources(dict(bundle) or {"CPU": 1})
+    with rt._lock:
+        nodes = [nm for nm in rt.nodes.values() if nm.alive]
+        frees = [Resources.from_fixed(nm.resources.available.fixed())
+                 for nm in nodes]
+    count = 0
+    while count < cap:
+        for i, free in enumerate(frees):
+            if req.fits_in(free):
+                frees[i] = free - req
+                count += 1
+                break
+        else:
+            break
+    return count
 
 
 def partition_chips_for_host(n_chips: int, n_workers: int,
@@ -142,21 +182,27 @@ class _TrainWorkerImpl:
         return jax.device_count()
 
     def run_loop(self, loop_blob: bytes, config: Optional[dict],
-                 checkpoint_blob: Optional[bytes], dataset_shard) -> bool:
+                 checkpoint_blob: Optional[bytes], dataset_shard,
+                 rank_state_blob: Optional[bytes] = None) -> bool:
         """Execute the user's train_loop_per_worker to completion. Runs on
         one actor thread while next_results() is served on another
         (max_concurrency=2 — the reference pairs a train thread with the
         session queue the same way)."""
+        import pickle
+
         import cloudpickle
 
         from . import session as session_mod
 
+        rank_state = (pickle.loads(rank_state_blob)
+                      if rank_state_blob else None)
         # init the session before anything that can fail or block, so a
         # concurrent next_results() poll never mistakes "not started yet"
         # for "finished" (it reports None only after s.finished is set)
         s = session_mod.init_session(
             world_rank=self.rank, world_size=self.world_size,
             checkpoint=None, dataset_shard=dataset_shard,
+            rank_state=rank_state,
         )
         try:
             loop = cloudpickle.loads(loop_blob)
@@ -353,17 +399,28 @@ class BackendExecutor:
         dataset_shards: Optional[List[Any]] = None,
         on_report: Optional[Callable[[List[dict]], None]] = None,
         poll_interval_s: float = 0.2,
+        rank_states: Optional[Dict[int, bytes]] = None,
+        world_watcher: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         """Ship the loop to every worker and drain reports until all loops
-        complete. Raises TrainingFailedError on worker failure."""
+        complete. Raises TrainingFailedError on worker failure (a dead
+        worker, actor, or NODE — the PR-3 agent-death plumbing surfaces
+        all three as errors on the polled refs) and ElasticResize when
+        ``world_watcher`` returns a different target world size.
+
+        ``rank_states`` hands each rank its restored loader-state shard
+        (session.get_rank_state()); ranks absent from the dict start
+        fresh."""
         from ..serialization import dumps_function
 
         assert self.group is not None, "call start() first"
         loop_blob = dumps_function(train_loop)
         ckpt_blob = checkpoint.to_bytes() if checkpoint else None
         shards = dataset_shards or [None] * self.num_workers
+        states = rank_states or {}
         done_refs = [
-            a.run_loop.remote(loop_blob, config, ckpt_blob, shards[i])
+            a.run_loop.remote(loop_blob, config, ckpt_blob, shards[i],
+                              states.get(i))
             for i, a in enumerate(self.group.actors)
         ]
         live = set(range(self.num_workers))
@@ -379,6 +436,11 @@ class BackendExecutor:
 
         try:
             while live:
+                if world_watcher is not None:
+                    target = world_watcher()
+                    if target is not None and target != self.num_workers:
+                        flush()
+                        raise ElasticResize(target)
                 refs = [
                     (i, self.group.actors[i].next_results.remote(0.5))
                     for i in sorted(live)
@@ -409,7 +471,8 @@ class BackendExecutor:
                     time.sleep(poll_interval_s)
             # surface loop errors (worker finished exceptionally)
             api.get(done_refs, timeout=60)
-        except (ActorError, TaskError, WorkerCrashedError) as e:
+        except (ActorError, TaskError, WorkerCrashedError,
+                NodeDeadError) as e:
             flush()
             raise TrainingFailedError(str(e)) from e
 

@@ -19,13 +19,17 @@ from .checkpoint import Checkpoint
 class _TrainSession:
     def __init__(self, world_rank: int, world_size: int,
                  checkpoint: Optional[Checkpoint], dataset_shard=None,
-                 trial_info: Optional[dict] = None):
+                 trial_info: Optional[dict] = None,
+                 rank_state: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.queue: "queue.Queue" = queue.Queue()
         self.loaded_checkpoint = checkpoint
         self.dataset_shard = dataset_shard
         self.trial_info = trial_info or {}
+        # per-rank loader state (step, rng, dataset offset) restored from
+        # the sharded checkpoint on elastic resume — see get_rank_state()
+        self.loaded_rank_state = rank_state
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
 
@@ -66,6 +70,24 @@ def report(metrics: Dict[str, Any],
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_session().loaded_checkpoint
+
+
+def get_rank_state() -> Optional[Dict[str, Any]]:
+    """This rank's data-loader state (step, rng, dataset offset, ...) as
+    restored from the latest sharded checkpoint, or None on a fresh start.
+    The loop saves it by passing its state dict to ``report(...,
+    checkpoint=...)`` on every rank — rank 0's checkpoint is the model,
+    every other rank's dict rides the same durable save as a shard.
+
+    After an ELASTIC resize the world size may differ from the one that
+    wrote the state: ranks beyond the old world get None, and the loop
+    re-derives its shard offsets from (step, world_size)."""
+    return get_session().loaded_rank_state
+
+
+def get_loader_state() -> Optional[Dict[str, Any]]:
+    """Alias for :func:`get_rank_state`."""
+    return get_rank_state()
 
 
 def get_world_rank() -> int:

@@ -15,6 +15,8 @@ control planes::
     spill.read         external-storage restore      (error/stall/corrupt/drop)
     control.dispatch   head -> node task dispatch    (error/stall/drop)
     worker.exec        worker-side task execution    (error/stall/drop)
+    checkpoint.save    train checkpoint durable write (error/stall/corrupt/drop)
+    checkpoint.restore train checkpoint load/verify   (error/stall/corrupt/drop)
 
 Each site × mode carries a probability, an optional activation offset
 (``after``: skip the first N hits) and budget (``max``: stop after N
@@ -55,6 +57,7 @@ MODES = ("drop", "stall", "error", "corrupt")
 SITES = (
     "transfer.send", "transfer.recv", "transfer.dial",
     "spill.write", "spill.read", "control.dispatch", "worker.exec",
+    "checkpoint.save", "checkpoint.restore",
 )
 
 
