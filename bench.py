@@ -306,6 +306,61 @@ def _transfer_suite():
         return {"error": repr(e)}
 
 
+# compressed-movement-plane fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): the ratio-vs-corpus
+# curve with BOTH raw (wire bytes / s) and effective (logical bytes / s)
+# GB/s plus the same-run uncompressed control, the compressed broadcast
+# chain, the incompressible-payload overhead bound, and the quantized
+# allreduce accuracy/wire-bytes table per precision.
+REQUIRED_COMPRESSION_FIELDS = (
+    "payload_mb", "codecs_offered", "corpora", "corpus_codec",
+    "corpus_ratio", "corpus_effective_gbps", "corpus_raw_gbps",
+    "corpus_uncompressed_gbps", "incompressible_overhead_pct",
+    "broadcast_corpus", "broadcast_effective_gbps", "broadcast_raw_gbps",
+    "broadcast_ratio", "broadcast_uncompressed_gbps",
+    "allreduce_err", "allreduce_wire_factor",
+)
+
+
+def _compression_suite():
+    """Compressed movement plane + quantized collectives
+    (utils/transfer_bench.py); fault-isolated so a failure still reports
+    the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.transfer_bench import (
+            run_compression_bench,
+        )
+
+        out = run_compression_bench()
+        for name in out["corpora"]:
+            print(
+                f"  compress {name:12s} [{out['corpus_codec'][name] or 'raw'}]"
+                f" ratio {out['corpus_ratio'][name]:9.1f}x  "
+                f"eff {out['corpus_effective_gbps'][name]:6.3f} GB/s  "
+                f"raw {out['corpus_raw_gbps'][name]:6.3f} GB/s  "
+                f"(uncompressed {out['corpus_uncompressed_gbps'][name]:6.3f})",
+                file=sys.stderr)
+        print(
+            f"  compress chain ({out['broadcast_corpus']}): "
+            f"{out['broadcast_effective_gbps']:.3f} GB/s effective / "
+            f"{out['broadcast_raw_gbps']:.3f} raw vs "
+            f"{out['broadcast_uncompressed_gbps']:.3f} uncompressed; "
+            f"incompressible overhead "
+            f"{out['incompressible_overhead_pct']:+.2f}%", file=sys.stderr)
+        print(
+            "  quantized allreduce err/wire: " + ", ".join(
+                f"{p}={out['allreduce_err'][p]:.2} "
+                f"({out['allreduce_wire_factor'][p]:.3}x fewer bytes)"
+                for p in out["allreduce_err"]), file=sys.stderr)
+        missing = [k for k in REQUIRED_COMPRESSION_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  compression suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 # locality-suite fields every BENCH_DETAIL.json must carry
 # (tests/test_bench_format.py enforces the set): the scheduling win —
 # tasks/s and bytes moved with the locality score on vs off, plus the
@@ -543,6 +598,7 @@ def main() -> None:
         rmt.shutdown()
 
     transfer = _transfer_suite()
+    compression = _compression_suite()
     locality = _locality_suite()
     tracing = _tracing_suite()
     elastic = _elastic_suite()
@@ -554,7 +610,8 @@ def main() -> None:
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
-              "transfer": transfer, "locality": locality,
+              "transfer": transfer, "compression": compression,
+              "locality": locality,
               "tracing": tracing, "elastic": elastic,
               "metrics": obs_metrics}
     import os
@@ -565,19 +622,21 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "tpu", "transfer", "locality",
+    for section in ("micro_stats", "scale", "tpu", "transfer",
+                    "compression", "locality",
                     "tracing", "elastic", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
-                        tpu, transfer, locality, tracing, elastic))
+                        tpu, transfer, locality, tracing, elastic,
+                        compression))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
-                  elastic=None):
+                  elastic=None, compression=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -626,6 +685,27 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
         line["tracing"] = {
             "overhead_pct": tracing["tracing_overhead_pct"],
         }
+    if compression and "error" not in compression:
+        # the compressed-plane acceptance numbers: best-corpus speedup of
+        # effective over the same-run uncompressed control, the chain's
+        # effective-vs-control, the incompressible bound, and int8 error
+        b = compression["broadcast_corpus"]
+        eff = compression["corpus_effective_gbps"]
+        ctl = compression["corpus_uncompressed_gbps"]
+        best = max(eff, key=lambda k: eff[k] / max(ctl[k], 1e-9))
+        line["compression"] = {
+            "best_corpus": best,
+            "eff_gbps": eff[best],
+            "vs_uncompressed": round(eff[best] / max(ctl[best], 1e-9), 2),
+            "chain_eff_gbps": compression["broadcast_effective_gbps"],
+            "chain_vs_uncompressed": round(
+                compression["broadcast_effective_gbps"]
+                / max(compression["broadcast_uncompressed_gbps"], 1e-9),
+                2),
+            "chain_corpus": b,
+            "incompressible_pct": compression["incompressible_overhead_pct"],
+            "int8_err": compression["allreduce_err"].get("int8"),
+        }
     if elastic and "error" not in elastic:
         # the elastic-training acceptance numbers: async step-blocking
         # cost (< 10% of sync) and kill-recovery wall-clock
@@ -655,8 +735,8 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("elastic", "tracing", "locality", "transfer", "micro",
-                  "scale"):
+        for k in ("compression", "elastic", "tracing", "locality",
+                  "transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

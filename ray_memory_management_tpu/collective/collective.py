@@ -57,14 +57,21 @@ def init_collective_group(
     backend: str = Backend.XLA,
     group_name: str = "default",
     devices: Optional[list] = None,
+    precision: Optional[str] = None,
 ):
     """Join (rank) this process/actor into a collective group
-    (reference :120)."""
+    (reference :120).
+
+    ``precision`` sets the group default for the reduction collectives
+    (allreduce/reduce/reducescatter): "f32" (bit-exact, the effective
+    default), "bf16" or "int8" quantize each rank's contribution before
+    the wire and accumulate at full precision. A per-call ``precision=``
+    overrides it; None defers to config.collective_precision."""
     backend = Backend.resolve(backend)
     if backend == Backend.XLA:
         from .mesh_group import MeshCollectives
 
-        group = MeshCollectives(devices)
+        group = MeshCollectives(devices, precision=precision)
         if world_size != group.world_size:
             raise ValueError(
                 f"xla backend: world_size {world_size} != "
@@ -76,7 +83,8 @@ def init_collective_group(
         from .coordinator import ObjstoreGroup, create_coordinator
 
         coord = create_coordinator(group_name, world_size)
-        group = ObjstoreGroup(coord, world_size, rank, group_name)
+        group = ObjstoreGroup(coord, world_size, rank, group_name,
+                              precision=precision)
     _group_mgr.put(group_name, group)
     return group
 
@@ -87,6 +95,7 @@ def create_collective_group(
     ranks: List[int],
     backend: str = Backend.OBJSTORE,
     group_name: str = "default",
+    precision: Optional[str] = None,
 ):
     """Declarative group over existing actors (reference :151): sends an
     ``init_collective_group`` call into every actor. Actor classes must
@@ -109,9 +118,16 @@ def create_collective_group(
     create_coordinator(group_name, world_size)  # pre-create, avoids races
     refs = []
     for actor, rank in zip(actors, ranks):
-        refs.append(actor._rmt_init_collective.remote(
-            world_size, rank, backend, group_name
-        ))
+        if precision is None:
+            # old positional shape: an actor class with a pre-precision
+            # _rmt_init_collective hook keeps working
+            refs.append(actor._rmt_init_collective.remote(
+                world_size, rank, backend, group_name
+            ))
+        else:
+            refs.append(actor._rmt_init_collective.remote(
+                world_size, rank, backend, group_name, precision
+            ))
     api.get(refs, timeout=120)
 
 
@@ -173,15 +189,23 @@ class _op_timer:
         return False
 
 
-def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
+              precision: Optional[str] = None):
+    """``precision="f32" | "bf16" | "int8"``: sub-f32 quantizes each
+    rank's shard before the wire (bf16 halves the moved bytes, int8 with
+    block-wise scales ~quarters them) and dequantizes+accumulates at
+    full f32 — EQuARX-style lossy-aware comms. Omit (None) for the group
+    default; f32 stays bit-exact."""
     with _op_timer("allreduce"):
-        return _group_mgr.get(group_name).allreduce(tensor, op)
+        return _group_mgr.get(group_name).allreduce(
+            tensor, op, precision=precision)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
-           op: str = ReduceOp.SUM):
+           op: str = ReduceOp.SUM, precision: Optional[str] = None):
     with _op_timer("reduce"):
-        return _group_mgr.get(group_name).reduce(tensor, dst_rank, op)
+        return _group_mgr.get(group_name).reduce(
+            tensor, dst_rank, op, precision=precision)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
@@ -195,9 +219,11 @@ def allgather(tensor, group_name: str = "default"):
 
 
 def reducescatter(tensor, group_name: str = "default",
-                  op: str = ReduceOp.SUM):
+                  op: str = ReduceOp.SUM,
+                  precision: Optional[str] = None):
     with _op_timer("reducescatter"):
-        return _group_mgr.get(group_name).reducescatter(tensor, op)
+        return _group_mgr.get(group_name).reducescatter(
+            tensor, op, precision=precision)
 
 
 def barrier(group_name: str = "default"):
@@ -220,6 +246,8 @@ class CollectiveGroupMixin:
     create_collective_group."""
 
     def _rmt_init_collective(self, world_size: int, rank: int, backend: str,
-                             group_name: str) -> bool:
-        init_collective_group(world_size, rank, backend, group_name)
+                             group_name: str,
+                             precision: Optional[str] = None) -> bool:
+        init_collective_group(world_size, rank, backend, group_name,
+                              precision=precision)
         return True

@@ -28,6 +28,14 @@ from .types import ReduceOp
 _AXIS = "ranks"
 
 
+from ..utils.jax_compat import (  # noqa: F401 — HAS_SHARD_MAP re-exported
+    HAS_SHARD_MAP,
+    shard_map as _shard_map_compat,
+)
+
+_INT8_BLOCK = 256  # must match core/codec.py's block-wise scale grain
+
+
 def _reduce_fn(op: str):
     def _product(t):
         # gather-then-multiply: exact for zeros/negatives/ints (an exp-of-
@@ -42,14 +50,61 @@ def _reduce_fn(op: str):
     }[op]
 
 
+_STACK_REDUCERS = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.PRODUCT: jnp.prod,
+}
+
+
+def _dequant_stack(t, precision: str):
+    """Inside a shard_map body: quantize this rank's shard, all_gather
+    the QUANTIZED payload (what actually crosses ICI — half the bytes
+    for bf16, ~quarter for int8+scales), and return the dequantized
+    [world, ...local] float32 stack. The caller reduces over axis 0 at
+    full precision — quantize-before-wire, f32 accumulation (EQuARX).
+    The jnp twin of core/codec.py's numpy kernels; the block-wise int8
+    scale math matches bit-for-bit so both backends report the same
+    accuracy envelope."""
+    if precision == "bf16":
+        g = lax.all_gather(t.astype(jnp.bfloat16), _AXIS, axis=0)
+        return g.astype(jnp.float32)
+    # int8, block-wise absmax scales (shapes are static under jit, so
+    # the padding below is compile-time)
+    shape = t.shape
+    flat = t.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % _INT8_BLOCK
+    padded = jnp.pad(flat, (0, pad)) if pad else flat
+    blocks = padded.reshape(-1, _INT8_BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
+    gq = lax.all_gather(q, _AXIS, axis=0)        # [world, nblk, B] int8
+    gs = lax.all_gather(scale, _AXIS, axis=0)    # [world, nblk, 1] f32
+    deq = (gq.astype(jnp.float32) * gs).reshape(gq.shape[0], -1)
+    return deq[:, :flat.size].reshape((gq.shape[0],) + shape)
+
+
+def _count_quantized(op: str, precision: str) -> None:
+    from ..core.codec import count_quantized_op
+
+    count_quantized_op(op, precision)
+
+
 class MeshCollectives:
     """Collectives over a 1-D mesh of devices (one 'rank' per device)."""
 
-    def __init__(self, devices: Optional[list] = None):
+    def __init__(self, devices: Optional[list] = None,
+                 precision: Optional[str] = None):
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(devices, (_AXIS,))
         self.world_size = len(devices)
         self._sharding = NamedSharding(self.mesh, P(_AXIS))
+        # group-level default precision for the reduction collectives;
+        # None defers to config.collective_precision, then "f32". A
+        # per-call precision= always wins.
+        self.precision = precision
         # per-instance program cache (an lru_cache on methods would pin the
         # instance and its compiled executables in a class-level cache
         # forever); dies with the group
@@ -67,30 +122,71 @@ class MeshCollectives:
         return jax.device_put(stacked, self._sharding)
 
     def _smap(self, fn, out_spec=P(_AXIS)):
-        # check_vma=False: collective bodies intentionally produce values
-        # whose replication XLA cannot infer statically (e.g. all_gather then
-        # replicated output)
-        return jax.shard_map(
-            fn, mesh=self.mesh, in_specs=P(_AXIS), out_specs=out_spec,
-            check_vma=False,
-        )
+        # check disabled (check_vma on new jax, check_rep on old):
+        # collective bodies intentionally produce values whose replication
+        # XLA cannot infer statically (e.g. all_gather then replicated
+        # output)
+        if not HAS_SHARD_MAP:
+            raise RuntimeError(
+                "this jax installation provides no shard_map "
+                "(neither jax.shard_map nor jax.experimental.shard_map); "
+                "xla-backend collectives are unavailable")
+        return _shard_map_compat(
+            fn, mesh=self.mesh, in_specs=P(_AXIS), out_specs=out_spec)
+
+    def _precision(self, precision):
+        from .types import resolve_precision
+
+        return resolve_precision(precision, self.precision)
 
     # -- collectives (each returns a jitted, cached program) ------------------
-    def _allreduce_fn(self, op: str):
+    def _allreduce_fn(self, op: str, precision: str = "f32"):
+        if precision == "f32":
+            # today's program, byte for byte — f32 stays bit-exact
+            return self._cached(
+                ("allreduce", op),
+                lambda: jax.jit(self._smap(_reduce_fn(op))),
+            )
+
+        def build():
+            red = _STACK_REDUCERS[op]
+
+            def body(t):
+                return red(_dequant_stack(t, precision), axis=0)
+
+            return jax.jit(self._smap(body))
+
+        return self._cached(("allreduce", op, precision), build)
+
+    def allreduce(self, stacked, op: str = ReduceOp.SUM,
+                  precision: Optional[str] = None):
+        """[world, ...] -> [world, ...] with every rank-slice = reduction.
+
+        ``precision``: "f32" (bit-exact default) | "bf16" | "int8" —
+        sub-f32 runs quantize-on-wire with f32 accumulation; result
+        dtype is float32 for quantized runs."""
+        p = self._precision(precision)
+        if p != "f32":
+            _count_quantized("allreduce", p)
+        return self._allreduce_fn(op, p)(self.shard_ranks(stacked))
+
+    def _reducescatter_fn(self, op: str, precision: str = "f32"):
+        key = (("reducescatter", op) if precision == "f32"
+               else ("reducescatter", op, precision))
         return self._cached(
-            ("allreduce", op),
-            lambda: jax.jit(self._smap(_reduce_fn(op))),
-        )
+            key, lambda: self._build_reducescatter(op, precision))
 
-    def allreduce(self, stacked, op: str = ReduceOp.SUM):
-        """[world, ...] -> [world, ...] with every rank-slice = reduction."""
-        return self._allreduce_fn(op)(self.shard_ranks(stacked))
+    def _build_reducescatter(self, op: str, precision: str = "f32"):
+        if precision != "f32":
+            red = _STACK_REDUCERS[op]
 
-    def _reducescatter_fn(self, op: str):
-        return self._cached(("reducescatter", op),
-                            lambda: self._build_reducescatter(op))
+            def qbody(t):
+                full = red(_dequant_stack(t, precision), axis=0)
+                rank = lax.axis_index(_AXIS)
+                n = t.shape[1] // self.world_size
+                return lax.dynamic_slice_in_dim(full, rank * n, n, axis=1)
 
-    def _build_reducescatter(self, op: str):
+            return jax.jit(self._smap(qbody))
         if op != ReduceOp.SUM:
             red = _reduce_fn(op)
 
@@ -106,9 +202,13 @@ class MeshCollectives:
                                        tiled=True)
         ))
 
-    def reducescatter(self, stacked, op: str = ReduceOp.SUM):
+    def reducescatter(self, stacked, op: str = ReduceOp.SUM,
+                      precision: Optional[str] = None):
         """[world, world*n] -> rank i holds sum-slice i ([world, n] global)."""
-        return self._reducescatter_fn(op)(self.shard_ranks(stacked))
+        p = self._precision(precision)
+        if p != "f32":
+            _count_quantized("reducescatter", p)
+        return self._reducescatter_fn(op, p)(self.shard_ranks(stacked))
 
     def _allgather_fn(self):
         # out_spec P(): every rank computes the identical full stack, so the
@@ -166,8 +266,19 @@ class MeshCollectives:
         collective.py:531,594 — NCCL P2P maps to ppermute on ICI)."""
         return self.ppermute(stacked, [(src, dst)])
 
-    def _reduce_rooted_fn(self, root: int, op: str):
+    def _reduce_rooted_fn(self, root: int, op: str,
+                          precision: str = "f32"):
         def build():
+            if precision != "f32":
+                sred = _STACK_REDUCERS[op]
+
+                def qbody(t):
+                    out = sred(_dequant_stack(t, precision), axis=0)
+                    rank = lax.axis_index(_AXIS)
+                    return jnp.where(rank == root, out,
+                                     t.astype(jnp.float32))
+
+                return jax.jit(self._smap(qbody))
             red = _reduce_fn(op)
 
             def body(t):
@@ -180,15 +291,21 @@ class MeshCollectives:
 
             return jax.jit(self._smap(body))
 
-        return self._cached(("reduce", root, op), build)
+        key = (("reduce", root, op) if precision == "f32"
+               else ("reduce", root, op, precision))
+        return self._cached(key, build)
 
-    def reduce(self, stacked, root_rank: int = 0, op: str = ReduceOp.SUM):
+    def reduce(self, stacked, root_rank: int = 0, op: str = ReduceOp.SUM,
+               precision: Optional[str] = None):
         """Rooted reduce: root's slice of the result holds the reduction;
         other slices pass through unchanged. (On ICI the wire cost matches
         allreduce — the ring crosses every link either way — but the
         SEMANTICS are rooted, as in the reference's collective.reduce,
         util/collective/collective.py:311.)"""
-        return self._reduce_rooted_fn(root_rank, op)(
+        p = self._precision(precision)
+        if p != "f32":
+            _count_quantized("reduce", p)
+        return self._reduce_rooted_fn(root_rank, op, p)(
             self.shard_ranks(stacked))
 
     def barrier(self):

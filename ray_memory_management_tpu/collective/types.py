@@ -38,6 +38,34 @@ class ReduceOp:
     MAX = "max"
 
 
+#: wire precisions for the reduction collectives. f32 is bit-exact
+#: (today's code path, byte for byte); bf16/int8 quantize each rank's
+#: contribution BEFORE the wire and dequantize+accumulate at f32
+#: (EQuARX-style — block-wise scale factors for int8). Strictly opt-in:
+#: per-call ``precision=`` > group default > config.collective_precision
+#: > "f32".
+PRECISIONS = ("f32", "bf16", "int8")
+
+
+def resolve_precision(call_precision, group_precision):
+    """The precedence chain above, shared by both backends; raises on an
+    unknown precision at the call site (not deep inside a jit trace)."""
+    p = call_precision if call_precision is not None else group_precision
+    if p is None:
+        try:
+            from ..config import global_config
+
+            p = getattr(global_config(), "collective_precision", None)
+        except Exception:  # noqa: BLE001 — config import cycles in tools
+            p = None
+    p = p or "f32"
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"unknown collective precision {p!r} (want one of "
+            f"{PRECISIONS})")
+    return p
+
+
 @dataclass
 class AllReduceOptions:
     reduceOp: str = ReduceOp.SUM

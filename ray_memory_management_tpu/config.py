@@ -173,6 +173,29 @@ _flag("transfer_verify_checksum", bool, True,
       "every materialization boundary (stripe completion, restore). A "
       "mismatch is treated as object loss — re-pull or reconstruct — "
       "never silent corruption.")
+_flag("transfer_compression", str, "off",
+      "Wire compression for the transfer plane (fetches, broadcast "
+      "tree, spill write/restore). 'off' sends raw bytes (today's "
+      "path, and what a codec-unaware v2 peer always gets); 'auto' "
+      "negotiates the best codec both ends support (lz4 when "
+      "available, else zlib); or name one codec ('zlib', 'lz4') to "
+      "pin it. Negotiation is additive inside wire protocol v2 — a "
+      "peer without the feature simply ignores the request key and "
+      "replies raw.")
+_flag("transfer_compress_min_bytes", int, 64 * 1024,
+      "Payloads below this many bytes are never compressed (the "
+      "syscall+CRC already dominates small pulls). Above it, a "
+      "trial-block probe still skips encoding for incompressible "
+      "payloads so the worst case stays within ~2% of the raw path.")
+_flag("transfer_compress_level", int, 1,
+      "zlib compression level for the wire codec (1 = fastest; the "
+      "wire wants throughput, not archival ratio).")
+_flag("collective_precision", str, "f32",
+      "Default precision for quantized collectives when neither the "
+      "op call nor the group names one: f32 (bit-exact, the default "
+      "— quantization is strictly opt-in), bf16 (half the wire "
+      "bytes), or int8 (block-wise scales, ~quarter the wire bytes); "
+      "dequantize+accumulate always happens at f32 (EQuARX-style).")
 _flag("spill_retry_attempts", int, 3,
       "Max attempts per spill/restore IO operation under the RetryPolicy.")
 _flag("spill_retry_backoff_s", float, 0.1,

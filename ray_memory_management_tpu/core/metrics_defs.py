@@ -129,6 +129,32 @@ DEFS: Dict[str, tuple] = {
     "rmt_transfer_auth_failures_total": (Counter, dict(
         description="Transfer dials refused at the authentication "
                     "handshake (non-retryable, distinct from peer death).")),
+    # compressed movement plane (wire codecs + quantized collectives):
+    # bytes_out/bytes_in is the achieved ratio per codec; the seconds
+    # histogram splits encode vs decode so a slow decompressor shows up
+    # on the right side of the wire.
+    "rmt_transfer_compress_bytes_in_total": (Counter, dict(
+        description="Logical (uncompressed) bytes entering a wire codec "
+                    "on encode, by codec.",
+        tag_keys=("codec",))),
+    "rmt_transfer_compress_bytes_out_total": (Counter, dict(
+        description="Compressed bytes leaving a wire codec for the wire "
+                    "on encode, by codec (out/in = achieved ratio).",
+        tag_keys=("codec",))),
+    "rmt_transfer_compress_seconds": (Histogram, dict(
+        description="Wire-codec CPU time per chunk, by codec and op "
+                    "(encode|decode).",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("codec", "op"))),
+    "rmt_transfer_compress_skipped_total": (Counter, dict(
+        description="Payloads that bypassed wire compression, by reason "
+                    "(below_threshold, incompressible probe verdict, "
+                    "no_codec negotiated).",
+        tag_keys=("reason",))),
+    "rmt_collective_quantized_ops_total": (Counter, dict(
+        description="Collective ops that quantized shards below f32 "
+                    "before the wire (dequantize+accumulate stays f32), "
+                    "by op and precision.",
+        tag_keys=("op", "precision"))),
     "rmt_spill_errors_total": (Counter, dict(
         description="Spill-storage IO errors (before retry), by op.",
         tag_keys=("op",))),
@@ -323,6 +349,26 @@ def transfer_checksum_mismatch() -> Counter:
 
 def transfer_auth_failures() -> Counter:
     return get("rmt_transfer_auth_failures_total")
+
+
+def transfer_compress_bytes_in() -> Counter:
+    return get("rmt_transfer_compress_bytes_in_total")
+
+
+def transfer_compress_bytes_out() -> Counter:
+    return get("rmt_transfer_compress_bytes_out_total")
+
+
+def transfer_compress_seconds() -> Histogram:
+    return get("rmt_transfer_compress_seconds")
+
+
+def transfer_compress_skipped() -> Counter:
+    return get("rmt_transfer_compress_skipped_total")
+
+
+def collective_quantized_ops() -> Counter:
+    return get("rmt_collective_quantized_ops_total")
 
 
 def spill_errors() -> Counter:

@@ -52,6 +52,7 @@ from typing import Any, Dict, Optional
 from ..config import Config
 from ..utils import faults
 from ..utils.retry import RetryPolicy
+from . import codec as wire_codec
 from .object_store import NodeObjectStore
 
 
@@ -129,7 +130,8 @@ class NodeAgent:
         self.transfer_server = TransferServer(
             self.store, authkey, self.config.object_manager_chunk_size,
             max_conns=self.config.transfer_max_conns,
-            idle_timeout=self.config.transfer_idle_timeout_s)
+            idle_timeout=self.config.transfer_idle_timeout_s,
+            compress_min_bytes=self.config.transfer_compress_min_bytes)
         # authenticated peer connections reused across pulls
         self._xfer_conn_pool = ConnectionPool(
             max_idle_per_peer=self.config.transfer_pool_size)
@@ -520,7 +522,8 @@ class NodeAgent:
                             plane="transfer"),
                         verify_checksum=self.config.transfer_verify_checksum,
                         stripe_deadline=self.config.transfer_stripe_deadline_s,
-                        trace=trace)
+                        trace=trace,
+                        codecs=wire_codec.client_codecs(self.config))
                 except Exception as e:  # noqa: BLE001
                     err = repr(e)
             try:

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 from ..config import Config
 from ..exceptions import ObjectStoreFullError
 from ..native import ShmStore, ShmStoreFullError
+from . import codec as wire_codec
 from . import external_storage as ext
 from ..serialization import SerializedObject
 from ..utils import faults, timeline, tracing
@@ -75,9 +76,20 @@ class NodeObjectStore:
         # would serialize a full extra pass onto the put path, halving put
         # bandwidth; the first transfer/spill that needs it pays it once)
         self._crc: Dict[bytes, int] = {}
-        # crc recorded at spill-write time, verified at restore — a worn
-        # spill volume corrupting at rest is a detected loss, not poison
+        # crc recorded at spill-write time over the STORED bytes
+        # (compressed when a codec applied), verified at restore BEFORE
+        # decode — a worn spill volume corrupting at rest is a detected
+        # loss, not poison; the decoded payload is then still checked
+        # against the full-object crc (verify after decode)
         self._spill_crc: Dict[bytes, int] = {}
+        # oid -> codec name for spill copies written compressed (same
+        # knob as the wire: transfer_compression; no negotiation needed
+        # — this process wrote it, this process decodes it)
+        self._spill_codec: Dict[bytes, str] = {}
+        # preference list for spill encoding (None when compression is
+        # off or the named codec is not importable); the per-payload
+        # pick runs through the same probe as the wire
+        self._spill_codecs = wire_codec.client_codecs(self.config)
         # unsealed creates by start time: a fetcher that dies mid-pull
         # leaks its allocation until restart without sweep_unsealed()
         self._unsealed: Dict[bytes, float] = {}
@@ -144,11 +156,15 @@ class NodeObjectStore:
                 self.shm.release(object_id)
         else:
             with self._spill_lock:
-                c = self._spill_crc.get(object_id)
+                # _spill_crc covers the STORED bytes — only the
+                # full-object crc when the copy was written raw
+                c = (None if object_id in self._spill_codec
+                     else self._spill_crc.get(object_id))
                 url = self._spilled.get(object_id)
             if c is None and url is not None:
                 try:
-                    c = crc32(self._storage.restore(object_id, url))
+                    # _spill_read verifies + DECODES (compressed copies)
+                    c = crc32(self._spill_read(object_id, url))
                 except Exception:  # noqa: BLE001 — concurrently deleted
                     return None
         if c is not None:
@@ -244,11 +260,38 @@ class NodeObjectStore:
     def _spill_io(self, object_id: bytes, view: memoryview) -> str:
         """One object's spill write under the unified RetryPolicy, with
         the ``spill.write`` fault site and a crc recorded for restore-time
-        verification. Runs on an IO thread."""
+        verification. Runs on an IO thread.
+
+        When the movement-plane codec is on (transfer_compression), the
+        spill copy is written COMPRESSED (above the same size threshold,
+        behind the same compressibility probe as the wire): fewer disk
+        bytes, and restore reads back proportionally less. Encoding
+        happens once, outside the retry loop; the recorded spill crc
+        covers the stored (compressed) bytes so restore verifies before
+        decode, while the decoded object keeps its full-object crc in
+        ``_crc`` (verify after decode)."""
         want = self._crc.get(object_id)
         if want is None:
             want = crc32(view)
             self._crc[object_id] = want
+        payload: memoryview = view
+        cname = None
+        if self._spill_codecs is not None:
+            if view.nbytes < self.config.transfer_compress_min_bytes:
+                wire_codec.count_skip("below_threshold")
+            else:
+                cand, skip = wire_codec.choose_codec(
+                    self._spill_codecs, wire_codec.available_codecs(),
+                    view)
+                if cand is None:
+                    wire_codec.count_skip(skip)
+                else:
+                    try:
+                        payload = memoryview(wire_codec.encode(view, cand))
+                        cname = cand
+                    except Exception:  # noqa: BLE001 — spill raw instead
+                        payload = view
+                        cname = None
 
         def once() -> str:
             try:
@@ -258,14 +301,16 @@ class NodeObjectStore:
                         act.sleep()
                     elif act.mode in ("error", "drop"):
                         act.raise_()
-                url = self._storage.spill(object_id, view)
-                if act is not None and act.mode == "corrupt":
+                url = self._storage.spill(object_id, payload)
+                if act is not None and act.mode in (
+                        "corrupt", "corrupt-compressed"):
                     # overwrite the spill copy with a flipped byte — the
                     # in-memory object is NEVER touched; only the
-                    # restore-time crc can catch this
+                    # restore-time crc (over the STORED bytes, so it
+                    # fires before any decode) can catch this
                     url = self._storage.spill(
                         object_id,
-                        memoryview(faults.corrupt_bytes(view)))
+                        memoryview(faults.corrupt_bytes(payload)))
                 return url
             except Exception:
                 from . import metrics_defs as mdefs
@@ -283,9 +328,16 @@ class NodeObjectStore:
         # a spill forced under a traced task's allocation carries its trace
         timeline.record_event(
             f"spill::write::{object_id.hex()[:8]}", "spill", t0,
-            time.time(), extra={"bytes": view.nbytes},
+            time.time(), extra={"bytes": view.nbytes,
+                                "stored_bytes": payload.nbytes,
+                                "codec": cname or "identity"},
             trace=tracing.get_current())
-        self._spill_crc[object_id] = want
+        self._spill_crc[object_id] = (
+            want if cname is None else crc32(payload))
+        if cname is not None:
+            self._spill_codec[object_id] = cname
+        else:
+            self._spill_codec.pop(object_id, None)
         return url
 
     def _spill_for(self, need_bytes: int) -> int:
@@ -436,8 +488,12 @@ class NodeObjectStore:
     def _spill_read(self, object_id: bytes, url: str) -> bytes:
         """One object's restore read under the unified RetryPolicy, with
         the ``spill.read`` fault site and crc verification against the
-        spill-time checksum. A mismatch that survives retries propagates
-        as loss (RetryExhausted) — corrupted bytes are NEVER returned."""
+        spill-time checksum — computed over the STORED bytes, so a
+        corrupt compressed copy is caught BEFORE the decoder runs; a
+        compressed copy is then decoded and re-verified against the
+        full-object crc (verify after decode). A mismatch that survives
+        retries propagates as loss (RetryExhausted) — corrupted bytes
+        are NEVER returned."""
 
         def once() -> bytes:
             try:
@@ -448,7 +504,8 @@ class NodeObjectStore:
                     elif act.mode in ("error", "drop"):
                         act.raise_()
                 data = self._storage.restore(object_id, url)
-                if act is not None and act.mode == "corrupt":
+                if act is not None and act.mode in (
+                        "corrupt", "corrupt-compressed"):
                     data = faults.corrupt_bytes(data)
                 want = self._spill_crc.get(object_id)
                 if want is not None \
@@ -460,6 +517,29 @@ class NodeObjectStore:
                     raise OSError(
                         f"spill payload checksum mismatch restoring "
                         f"{object_id.hex()[:12]} from {url}")
+                cname = self._spill_codec.get(object_id)
+                if cname is not None:
+                    try:
+                        data = wire_codec.decode(data, cname)
+                    except wire_codec.CodecError as e:
+                        from . import metrics_defs as mdefs
+
+                        mdefs.spill_errors().inc(tags={"op": "checksum"})
+                        raise OSError(
+                            f"spill payload decode failed restoring "
+                            f"{object_id.hex()[:12]} from {url}: "
+                            f"{e}") from e
+                    decoded_want = self._crc.get(object_id)
+                    if decoded_want is not None \
+                            and self.config.transfer_verify_checksum \
+                            and crc32(data) != decoded_want:
+                        from . import metrics_defs as mdefs
+
+                        mdefs.spill_errors().inc(tags={"op": "checksum"})
+                        raise OSError(
+                            f"decoded spill payload checksum mismatch "
+                            f"restoring {object_id.hex()[:12]} from "
+                            f"{url}")
                 return data
             except FileNotFoundError:
                 raise  # concurrent delete, not an IO failure
@@ -517,6 +597,7 @@ class NodeObjectStore:
             out = self.shm.get(object_id)
             self._spilled.pop(object_id, None)
             self._spill_crc.pop(object_id, None)
+            self._spill_codec.pop(object_id, None)
         # synchronous: a delete queued on the _io pool would be dropped by
         # close()'s shutdown(wait=False), orphaning the spill file
         self._storage.delete(url)
@@ -559,6 +640,7 @@ class NodeObjectStore:
             url = self._spilled.pop(object_id, None)
             pin = self._pinned.pop(object_id, None)
             self._spill_crc.pop(object_id, None)
+            self._spill_codec.pop(object_id, None)
         self._crc.pop(object_id, None)
         self._unsealed.pop(object_id, None)
         if pin is not None:

@@ -46,6 +46,7 @@ from ..utils import events, timeline, tracing
 from .gcs import (
     ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING, ActorRecord, GCS,
 )
+from . import codec as wire_codec
 from . import metrics_defs as mdefs
 from .node_manager import NodeManager, WorkerHandle
 from .object_ref import ObjectRef
@@ -1700,7 +1701,9 @@ class Runtime:
                     self.nodes[node_id].store, self._authkey,
                     self.config.object_manager_chunk_size,
                     max_conns=self.config.transfer_max_conns,
-                    idle_timeout=self.config.transfer_idle_timeout_s)
+                    idle_timeout=self.config.transfer_idle_timeout_s,
+                    compress_min_bytes=(
+                        self.config.transfer_compress_min_bytes))
                 self._xfer_servers[node_id] = srv
         return srv
 
@@ -1781,7 +1784,8 @@ class Runtime:
                     retry=self._fetch_policy(),
                     verify_checksum=self.config.transfer_verify_checksum,
                     stripe_deadline=self.config.transfer_stripe_deadline_s,
-                    trace=trace)
+                    trace=trace,
+                    codecs=wire_codec.client_codecs(self.config))
                 if err is None:
                     self.gcs.add_object_location(oid, dst)
                     return
@@ -2991,7 +2995,8 @@ class Runtime:
                 alt_sources=lambda: self._holder_addrs(oid),
                 retry=self._fetch_policy(),
                 verify_checksum=self.config.transfer_verify_checksum,
-                stripe_deadline=self.config.transfer_stripe_deadline_s)
+                stripe_deadline=self.config.transfer_stripe_deadline_s,
+                codecs=wire_codec.client_codecs(self.config))
             if err is None:
                 self.gcs.add_object_location(oid, head.node_id)
                 local = [head.node_id]

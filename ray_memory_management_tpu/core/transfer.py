@@ -48,6 +48,21 @@ both versions):
     server -> client   raw chunk frames until ``size`` bytes are sent
     ...the connection then awaits the next request (idle timeout applies).
 
+Codec negotiation (additive, still v2 — the same pattern as ``crc`` /
+``defer_above``): a payload-bearing request MAY carry ``"codecs": (names
+best-first)`` naming the lossless wire codecs the CLIENT can decode. A
+codec-unaware server ignores the key and streams raw; a codec-aware
+server picks the first name it also supports and — only when the span
+clears ``compress_min_bytes`` AND a trial-block probe says the bytes are
+actually compressible — answers with ``"codec": <name>`` and streams
+CRC-PREFIXED COMPRESSED FRAMES (4-byte big-endian CRC32 of the
+compressed chunk, then the chunk) instead of raw chunks. A codec-unaware
+client never sends the key, so it never sees a compressed frame. Frame
+CRCs are verified BEFORE decode (a wire bit flip never reaches the
+decompressor); the decoded payload is still verified against the
+full-object ``crc`` (verify after decode). Either failure is object loss
+— abort the unsealed create and re-pull — never silent corruption.
+
 ``defer_above`` lets one request serve both sizes: a small object streams
 immediately (single round trip); a large one answers with its size only so
 the client can allocate once and fan the payload out as range requests.
@@ -69,6 +84,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..utils import faults
 from ..utils.integrity import crc32, crc32_combine
 from ..utils.retry import RetryPolicy
+from . import codec as wire_codec
 
 _CONNECT_TIMEOUT = 20.0
 # per-stripe progress deadline default (config: transfer_stripe_deadline_s):
@@ -81,6 +97,7 @@ _DEFAULT_STRIPE_DEADLINE = 30.0
 _DEFAULT_STRIPE_THRESHOLD = 8 * 1024 * 1024
 _DEFAULT_STRIPE_COUNT = 4
 _MIN_STRIPE_BYTES = 1 << 20  # never split below 1 MiB per stripe
+_DEFAULT_COMPRESS_MIN = 64 * 1024  # config: transfer_compress_min_bytes
 
 
 def _observe_transfer(direction: str, nbytes: int, seconds: float) -> None:
@@ -180,13 +197,28 @@ class TransferServer:
 
     def __init__(self, store, authkey: bytes, chunk_size: int,
                  bind_host: str = "0.0.0.0", max_conns: int = 32,
-                 idle_timeout: float = 30.0, bind_port: int = 0):
+                 idle_timeout: float = 30.0, bind_port: int = 0,
+                 compression: str = "auto",
+                 compress_min_bytes: int = _DEFAULT_COMPRESS_MIN):
         from multiprocessing.connection import Listener
 
         self.store = store
         self.chunk_size = chunk_size
         self.idle_timeout = idle_timeout
         self._authkey = authkey
+        # serve-side willingness to compress: "auto" honors whatever the
+        # CLIENT offers (the puller drives, receiver-driven like
+        # everything else here), a codec name pins that one, "off" never
+        # compresses. The client-side knob is config.transfer_compression
+        # (it decides whether a fetch OFFERS codecs at all).
+        self.compress_min_bytes = int(compress_min_bytes)
+        if compression == "off":
+            self._codecs: Tuple[str, ...] = ()
+        elif compression == "auto":
+            self._codecs = wire_codec.available_codecs()
+        else:
+            self._codecs = (compression,) if (
+                compression in wire_codec.available_codecs()) else ()
         # NO authkey on the Listener: accept() would run the challenge
         # handshake on the single accept thread, letting one stalled peer
         # wedge the whole server. The handshake runs per-connection on the
@@ -201,7 +233,9 @@ class TransferServer:
         # monotonic counters)
         self.connections_accepted = 0
         self.requests_served = 0
-        self.bytes_served = 0
+        self.bytes_served = 0        # logical payload bytes (decoded)
+        self.bytes_served_wire = 0   # bytes actually on the wire
+        self.compressed_serves = 0
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="xfer-accept").start()
 
@@ -292,7 +326,11 @@ class TransferServer:
         # fault plane, serve side: drop vanishes mid-request (peer sees
         # EOF), stall delays the reply past the client's stripe deadline,
         # error answers with a refusal, corrupt flips a payload byte on
-        # the wire (the store's copy is NEVER touched)
+        # the wire BEFORE any encode (the decoded-payload crc catches
+        # it), corrupt-compressed flips a byte inside a compressed frame
+        # AFTER its frame crc is stamped (the pre-decode frame crc
+        # catches it; a no-op on uncompressed serves). The store's copy
+        # is NEVER touched.
         act = faults.fire("transfer.send")
         if act is not None:
             if act.mode == "stall":
@@ -304,6 +342,7 @@ class TransferServer:
             elif act.mode == "drop":
                 return False
         corrupt = act is not None and act.mode == "corrupt"
+        corrupt_comp = act is not None and act.mode == "corrupt-compressed"
         oid = req["oid"]
         trace = req.get("trace")
         w0 = time.time()
@@ -340,19 +379,57 @@ class TransferServer:
                 c = _store_crc(self.store, oid)
                 if c is not None:
                     reply["crc"] = c
+            # codec negotiation: compress only when the client offered a
+            # codec we speak, the span clears the threshold, AND the
+            # trial-block probe says the bytes will actually shrink —
+            # incompressible payloads (ciphertext, random floats) skip
+            # encoding entirely so the worst case stays ~the raw path
+            cname = None
+            offered = req.get("codecs")
+            if offered and self._codecs:
+                if span < self.compress_min_bytes:
+                    wire_codec.count_skip("below_threshold")
+                else:
+                    cname, skip = wire_codec.choose_codec(
+                        offered, self._codecs, view, span, offset)
+                    if cname is None:
+                        wire_codec.count_skip(skip)
+                    else:
+                        reply["codec"] = cname
             conn.send(reply)
             mv = memoryview(view)
+            wire_bytes = 0
             try:
                 for off in range(offset, offset + span, self.chunk_size):
                     end = min(off + self.chunk_size, offset + span)
+                    chunk = mv[off:end]
                     if corrupt and off == offset:
-                        conn.send_bytes(faults.corrupt_bytes(mv[off:end]))
+                        chunk = faults.corrupt_bytes(chunk)
+                    if cname is None:
+                        conn.send_bytes(chunk)
+                        wire_bytes += end - off
                     else:
-                        conn.send_bytes(mv[off:end])
+                        frame = wire_codec.encode_frame(chunk, cname)
+                        if corrupt_comp and off == offset:
+                            # flip a byte of the COMPRESSED payload after
+                            # its crc was stamped — exactly a wire bit
+                            # flip; the client's frame verify must catch
+                            # it before the decoder runs
+                            frame = frame[:4] + faults.corrupt_bytes(
+                                frame[4:])
+                        conn.send_bytes(frame)
+                        wire_bytes += len(frame)
             finally:
                 mv.release()
-            self.requests_served += 1
+            # byte/codec counters first, requests_served LAST: the client's
+            # fetch returns the instant the final chunk lands, so readers
+            # (bench, tests) use requests_served as the barrier proving
+            # this request's accounting is complete
+            self.bytes_served_wire += wire_bytes
             self.bytes_served += span
+            if cname is not None:
+                self.compressed_serves += 1
+            self.requests_served += 1
             if offset or (length is not None and span < n):
                 _count("transfer_stripe_requests")
             _observe_transfer("serve", span, time.monotonic() - t0)
@@ -591,8 +668,48 @@ def _recv_exact(conn, sub) -> None:
         sub[0:1] = bytes([sub[0] ^ 0xFF])
 
 
+def _recv_compressed(conn, sub, cname: str,
+                     verify_frames: bool = True) -> None:
+    """Stream CRC-prefixed compressed frames into ``sub`` until its
+    span is fully decoded. Each frame's CRC is verified BEFORE decode;
+    a frame integrity or decode failure raises OSError so the caller
+    discards the connection (the stream position is unknowable) and the
+    fetch aborts its unsealed create and re-pulls — the same loss path
+    a raw checksum mismatch takes, never sealing garbage.
+
+    Fault plane: same receive-side physics as :func:`_recv_exact`
+    (corrupt flips a landed byte AFTER decode — only the decoded-payload
+    crc can catch that one)."""
+    act = faults.fire("transfer.recv")
+    if act is not None:
+        if act.mode == "stall":
+            act.sleep()
+        elif act.mode == "error":
+            act.raise_()
+        elif act.mode == "drop":
+            _shutdown_fd(conn.fileno())
+    size = sub.nbytes
+    got = 0
+    while got < size:
+        frame = conn.recv_bytes()
+        try:
+            # decode lands directly in the destination view (zrle's zero
+            # blocks become one memset — no intermediate materialization)
+            got += wire_codec.decode_frame_into(
+                frame, cname, sub[got:], verify_crc=verify_frames)
+        except (wire_codec.FrameIntegrityError,
+                wire_codec.CodecError) as e:
+            _count("transfer_checksum_mismatch")
+            raise OSError(
+                f"compressed frame ({cname}) failed integrity/decode: "
+                f"{e}") from e
+    if act is not None and act.mode == "corrupt" and size:
+        sub[0:1] = bytes([sub[0] ^ 0xFF])
+
+
 def _request_range(conn, oid: bytes, offset: int, length: int, sub,
-                   proto: int, trace=None) -> None:
+                   proto: int, trace=None, codecs=None,
+                   verify_checksum: bool = True) -> None:
     """One range request on an authenticated connection: header exchange,
     then stream the span straight into ``sub``. Raises on any mismatch
     or stream failure (caller aborts the whole fetch)."""
@@ -600,6 +717,8 @@ def _request_range(conn, oid: bytes, offset: int, length: int, sub,
            "length": length}
     if trace:
         req["trace"] = tuple(trace)
+    if codecs:
+        req["codecs"] = tuple(codecs)
     conn.send(req)
     hdr = conn.recv()
     err = hdr.get("error")
@@ -608,7 +727,11 @@ def _request_range(conn, oid: bytes, offset: int, length: int, sub,
     if hdr["size"] != length:
         raise OSError(f"range [{offset}, {offset + length}) answered "
                       f"{hdr['size']} bytes")
-    _recv_exact(conn, sub)
+    cname = hdr.get("codec")
+    if cname:
+        _recv_compressed(conn, sub, cname, verify_frames=verify_checksum)
+    else:
+        _recv_exact(conn, sub)
 
 
 def _stripe_ranges(total: int, stripe_count: int) -> List[Tuple[int, int]]:
@@ -635,9 +758,14 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
                  retry: Optional[RetryPolicy] = None,
                  verify_checksum: bool = True,
                  stripe_deadline: Optional[float] = None,
-                 trace=None) -> Optional[str]:
+                 trace=None, codecs=None) -> Optional[str]:
     """Pull one object from a peer's TransferServer straight into
     ``dst_store``. Returns None on success, an error string on failure.
+
+    ``codecs``: lossless wire codecs THIS client can decode, best-first
+    (``codec.client_codecs(config)``); None (the default) sends no codec
+    keys at all — indistinguishable on the wire from a codec-unaware v2
+    peer, so every existing caller keeps today's raw path.
 
     The receive lands chunk-by-chunk in the store allocation itself
     (``recv_bytes_into`` on the shm view) — no full-object staging buffer
@@ -687,7 +815,7 @@ def fetch_object(host: str, port: int, authkey: bytes, oid: bytes,
         err = _fetch_once(h, p, authkey, oid, dst_store, chunk_size,
                           timeout, pool, stripe_threshold, stripe_count,
                           alt_sources, verify_checksum, stripe_deadline,
-                          trace=trace)
+                          trace=trace, codecs=codecs)
         if err is None:
             return None
         if not policy.is_retryable(err):
@@ -715,7 +843,7 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                 alt_sources: Optional[Callable],
                 verify_checksum: bool,
                 stripe_deadline: Optional[float],
-                trace=None) -> Optional[str]:
+                trace=None, codecs=None) -> Optional[str]:
     """One fetch attempt from one source (the pre-policy fetch_object
     body). Returns None on success, an error string on failure; never
     leaves an unsealed create behind."""
@@ -761,6 +889,8 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                          "defer_above": stripe_threshold}
             if trace:
                 first_req["trace"] = tuple(trace)
+            if codecs:
+                first_req["codecs"] = tuple(codecs)
             conn.send(first_req)
             hdr = conn.recv()
             break
@@ -792,7 +922,12 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                 conn = None
                 return race_err
             try:
-                _recv_exact(conn, buf)
+                cname = hdr.get("codec")
+                if cname:
+                    _recv_compressed(conn, buf, cname,
+                                     verify_frames=verify_checksum)
+                else:
+                    _recv_exact(conn, buf)
                 if verify_checksum and expect_crc is not None \
                         and crc32(buf) != expect_crc:
                     _count("transfer_checksum_mismatch")
@@ -831,7 +966,7 @@ def _fetch_once(host: str, port: int, authkey: bytes, oid: bytes,
                               expect_crc=expect_crc,
                               verify_checksum=verify_checksum,
                               stripe_deadline=stripe_deadline,
-                              trace=trace)
+                              trace=trace, codecs=codecs)
     except _ChecksumMismatch as e:
         # the stream was fully consumed before the verify — the
         # connection stays usable, but the payload is poison
@@ -857,7 +992,7 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
                    expect_crc: Optional[int] = None,
                    verify_checksum: bool = True,
                    stripe_deadline: Optional[float] = None,
-                   trace=None) -> Optional[str]:
+                   trace=None, codecs=None) -> Optional[str]:
     """Fan ``total`` bytes out as parallel range requests into disjoint
     slices of ``buf`` (the already-created, unsealed allocation).
     ``first_conn`` carries stripe 0; each other stripe acquires its own
@@ -890,7 +1025,11 @@ def _striped_fetch(host: str, port: int, authkey: bytes, oid: bytes,
             _set_io_timeout(conn.fileno(),
                             min(stripe_deadline, timeout))
             _request_range(conn, oid, offset, span, sub,
-                           WIRE_PROTOCOL_VERSION, trace=trace)
+                           WIRE_PROTOCOL_VERSION, trace=trace,
+                           codecs=codecs, verify_checksum=verify_checksum)
+            # crc over the DECODED stripe — the verify-after-decode half
+            # of the integrity story (the frame crc already covered the
+            # compressed bytes pre-decode)
             c = crc32(sub) if verify_checksum else 0
         except BaseException as e:  # noqa: BLE001
             ConnectionPool.discard(conn)

@@ -34,8 +34,10 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
 
 
 def stage_pspec(n_dims: int, axis: str = "pp") -> P:
@@ -104,7 +106,6 @@ def pipeline_blocks(
         shard_map, mesh=mesh,
         in_specs=(param_specs, bspec),
         out_specs=out_specs,
-        check_vma=False,
     )
     def run(params_local, x_local):
         stage = lax.axis_index(axis)

@@ -8,7 +8,11 @@ in ML Training on Google TPUs", arxiv 2011.03641). This module gives the
 runtime a registry of named injection points wired through the data and
 control planes::
 
-    transfer.send      TransferServer request serving (drop/stall/error/corrupt)
+    transfer.send      TransferServer request serving (drop/stall/error/corrupt/
+                       corrupt-compressed: flip a byte INSIDE a compressed
+                       frame after its CRC is stamped — proves the
+                       frame checksum catches wire bit flips before the
+                       decoder runs; a no-op on uncompressed replies)
     transfer.recv      client-side payload receive   (stall/error/corrupt/drop)
     transfer.dial      connect + handshake           (error/stall/drop)
     spill.write        external-storage spill        (error/stall/corrupt/drop)
@@ -52,7 +56,7 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
-MODES = ("drop", "stall", "error", "corrupt")
+MODES = ("drop", "stall", "error", "corrupt", "corrupt-compressed")
 
 SITES = (
     "transfer.send", "transfer.recv", "transfer.dial",

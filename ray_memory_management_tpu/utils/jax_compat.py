@@ -1,0 +1,43 @@
+"""Version-spanning jax shims.
+
+shard_map moved twice across the jax versions this runtime supports: new
+jax exposes ``jax.shard_map`` (whose replication-check kwarg is
+``check_vma``); older jax (<=0.4.x) only has
+``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``). Callers
+import ``shard_map``/``HAS_SHARD_MAP`` from here instead of feature-
+detecting at every site — and instead of a bare ``from jax import
+shard_map`` that turns the whole module into an ImportError on older jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _resolve_shard_map():
+    """Returns (callable, check_kwarg_name), or (None, None) when this
+    jax has no shard_map at all — callers raise/skip instead of a
+    collection error."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"
+    try:
+        from jax.experimental.shard_map import shard_map as fn
+        return fn, "check_rep"
+    except Exception:  # noqa: BLE001 — truly no shard_map in this jax
+        return None, None
+
+
+_SHARD_MAP, _CHECK_KW = _resolve_shard_map()
+HAS_SHARD_MAP = _SHARD_MAP is not None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check=False):
+    """shard_map with the replication check named portably (the kwarg is
+    ``check_vma`` on new jax, ``check_rep`` on old)."""
+    if _SHARD_MAP is None:
+        raise RuntimeError(
+            "this jax provides no shard_map (neither jax.shard_map nor "
+            "jax.experimental.shard_map)")
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check})

@@ -373,7 +373,7 @@ def allreduce_busbw(size_mb: int = 64,
     @jax.jit
     def run(x):
         def body(i, y):
-            from jax.experimental.shard_map import shard_map
+            from .jax_compat import shard_map
 
             f = shard_map(lambda a: lax.psum(a, "x"), mesh=mesh,
                           in_specs=P("x", None), out_specs=P("x", None))

@@ -257,6 +257,79 @@ def test_elastic_suite_reports_required_fields(bench):
     assert out["async_blocking_vs_sync_pct"] < 50
 
 
+def test_compression_bench_reports_required_fields(bench):
+    """The compressed-movement-plane suite must emit every field the
+    BENCH_DETAIL.json contract names (per-corpus ratio + BOTH raw and
+    effective GB/s plus the same-run uncompressed control, the
+    incompressible overhead bound, the broadcast chain, and the
+    per-precision allreduce accuracy) — run a mini-sized pass so CI
+    proves the real code path, not a fixture."""
+    from ray_memory_management_tpu.utils.transfer_bench import (
+        run_compression_bench,
+    )
+
+    out = run_compression_bench(payload_mb=8, n_dests=2, trials=1,
+                                overhead_trials=1)
+    missing = [k for k in bench.REQUIRED_COMPRESSION_FIELDS
+               if k not in out]
+    assert not missing, missing
+    for name in out["corpora"]:
+        assert out["corpus_effective_gbps"][name] > 0, name
+        assert out["corpus_raw_gbps"][name] > 0, name
+        assert out["corpus_uncompressed_gbps"][name] > 0, name
+        assert out["corpus_ratio"][name] >= 1.0, name
+    # the sparse gradient corpus must actually compress on the wire
+    assert out["corpus_ratio"]["sparse-grad"] > 2.0
+    assert out["corpus_codec"]["random"] is None  # probe skipped it
+    assert out["broadcast_effective_gbps"] > 0
+    # per-precision accuracy: f32 bit-exact, sub-f32 within envelope
+    assert out["allreduce_err"]["f32"] == 0.0
+    assert 0 < out["allreduce_err"]["bf16"] <= 2.0 ** -7
+    assert 0 < out["allreduce_err"]["int8"] <= 1.5 / 127.0
+    assert out["allreduce_wire_factor"]["bf16"] == pytest.approx(2.0)
+    assert out["allreduce_wire_factor"]["int8"] > 3.0
+
+
+def test_headline_line_carries_compression_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    compression = {
+        "broadcast_corpus": "sparse-grad",
+        "corpus_effective_gbps": {"zeros": 0.7, "random": 0.5},
+        "corpus_uncompressed_gbps": {"zeros": 0.35, "random": 0.5},
+        "broadcast_effective_gbps": 0.4,
+        "broadcast_uncompressed_gbps": 0.2,
+        "incompressible_overhead_pct": 1.1,
+        "allreduce_err": {"f32": 0.0, "bf16": 0.002, "int8": 0.005},
+    }
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, None, None, None, None,
+                                  compression)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "compression" in line:  # may be popped only by the <1KB guard
+        assert line["compression"]["best_corpus"] == "zeros"
+        assert line["compression"]["vs_uncompressed"] == 2.0
+        assert line["compression"]["chain_vs_uncompressed"] == 2.0
+        assert line["compression"]["int8_err"] == 0.005
+
+
+def test_bench_detail_snapshot_has_compression_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the compression section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    compression = detail.get("compression")
+    if compression is None:
+        pytest.skip("snapshot predates the compression section")
+    if "error" not in compression:
+        missing = [k for k in bench.REQUIRED_COMPRESSION_FIELDS
+                   if k not in compression]
+        assert not missing, missing
+
+
 def test_headline_line_carries_elastic_summary(bench):
     results, stats, ratios, scale, tpu = _bloated_inputs()
     elastic = {"async_blocking_vs_sync_pct": 4.2, "recovery_s": 1.7}

@@ -10,6 +10,7 @@ import pytest
 
 import ray_memory_management_tpu as rmt
 from ray_memory_management_tpu import collective as col
+from ray_memory_management_tpu.core import metrics_defs as mdefs
 
 
 # ---------------------------------------------------------------- xla / mesh
@@ -17,6 +18,10 @@ from ray_memory_management_tpu import collective as col
 def mesh_group():
     import jax
 
+    if not col.HAS_SHARD_MAP:
+        pytest.skip("this jax provides no shard_map (neither jax.shard_map "
+                    "nor jax.experimental.shard_map) — xla-backend "
+                    "collectives are unavailable")
     devices = jax.devices("cpu")
     assert len(devices) >= 8, "conftest must force 8 CPU devices"
     return col.MeshCollectives(devices[:8])
@@ -134,6 +139,11 @@ class Rank(col.CollectiveGroupMixin):
         return col.reducescatter(
             np.arange(self.world * 2, dtype=np.float32) + base, "grp")
 
+    def do_allreduce_q(self, value, precision):
+        out = col.allreduce(np.full((4,), value, np.float32) + 0.1,
+                            group_name="grp", precision=precision)
+        return np.asarray(out)
+
     def do_sendrecv(self, value):
         if self.rank == 0:
             col.send(np.full((2,), value, np.float32), 1, "grp")
@@ -201,3 +211,100 @@ def test_mesh_allreduce_product_with_zeros_and_negatives(mesh_group):
     expect = stacked.prod(axis=0)
     for r in range(w):
         np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+
+# ------------------------------------------------------- quantized precision
+def _quant_count(op: str, precision: str) -> float:
+    return mdefs.collective_quantized_ops().get(
+        tags={"op": op, "precision": precision})
+
+
+@pytest.mark.parametrize("precision,tol", [("bf16", 2.0 ** -7),
+                                           ("int8", 0.75 / 127.0)])
+def test_mesh_allreduce_quantized_accuracy(mesh_group, precision, tol):
+    """Sub-f32 allreduce: quantize-before-wire, f32 accumulation — the
+    result must stay within the precision's error envelope (relative to
+    the input absmax; elementwise relative error is meaningless near
+    zero crossings) and bump the quantized-ops counter."""
+    w = mesh_group.world_size
+    rng = np.random.default_rng(21)
+    stacked = rng.standard_normal((w, 512)).astype(np.float32)
+    exact = stacked.sum(axis=0)
+    absmax = float(np.abs(stacked).max())
+    before = _quant_count("allreduce", precision)
+    out = np.asarray(mesh_group.allreduce(stacked, precision=precision))
+    assert _quant_count("allreduce", precision) == before + 1
+    for r in range(w):
+        np.testing.assert_allclose(out[r], exact, rtol=0,
+                                   atol=w * absmax * tol)
+
+
+def test_mesh_allreduce_f32_stays_bit_exact(mesh_group):
+    w = mesh_group.world_size
+    rng = np.random.default_rng(22)
+    stacked = rng.standard_normal((w, 256)).astype(np.float32)
+    before = _quant_count("allreduce", "f32")
+    default = np.asarray(mesh_group.allreduce(stacked))
+    explicit = np.asarray(mesh_group.allreduce(stacked, precision="f32"))
+    assert np.array_equal(default, explicit)  # today's program, bit-exact
+    assert _quant_count("allreduce", "f32") == before  # f32 never counted
+
+
+def test_mesh_reducescatter_quantized(mesh_group):
+    w = mesh_group.world_size
+    rng = np.random.default_rng(23)
+    stacked = rng.standard_normal((w, w * 4)).astype(np.float32)
+    total = stacked.sum(axis=0)
+    absmax = float(np.abs(stacked).max())
+    out = np.asarray(mesh_group.reducescatter(stacked, precision="int8"))
+    for r in range(w):
+        np.testing.assert_allclose(out[r], total[r * 4:(r + 1) * 4],
+                                   rtol=0, atol=w * absmax * 0.75 / 127.0)
+
+
+def test_precision_precedence_chain():
+    """per-call > group default > config.collective_precision > f32."""
+    from ray_memory_management_tpu.config import (
+        Config, global_config, set_global_config,
+    )
+
+    assert col.resolve_precision("int8", "bf16") == "int8"
+    assert col.resolve_precision(None, "bf16") == "bf16"
+    prev = global_config()
+    try:
+        set_global_config(Config(collective_precision="int8"))
+        assert col.resolve_precision(None, None) == "int8"
+    finally:
+        set_global_config(prev)
+    assert col.resolve_precision(None, None) == "f32"
+    with pytest.raises(ValueError):
+        col.resolve_precision("fp4", None)
+
+
+def test_mesh_group_default_precision(mesh_group):
+    """A group-level default applies when the call names none; a per-call
+    precision= always wins over it."""
+    import jax
+
+    g = col.MeshCollectives(jax.devices("cpu")[:8], precision="bf16")
+    w = g.world_size
+    stacked = np.stack([np.full((4,), i + 0.5, np.float32)
+                        for i in range(w)])
+    expect = stacked.sum(axis=0)
+    before = _quant_count("allreduce", "bf16")
+    out = np.asarray(g.allreduce(stacked))
+    assert _quant_count("allreduce", "bf16") == before + 1
+    np.testing.assert_allclose(out[0], expect, rtol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(g.allreduce(stacked, precision="f32"))[0], expect)
+    assert _quant_count("allreduce", "bf16") == before + 1  # f32 call won
+
+
+def test_objstore_allreduce_quantized(rank_actors):
+    """The objstore backend carries the QUANTIZED payload across the
+    object plane; dequantize+accumulate stays f32 on every rank."""
+    outs = rmt.get([a.do_allreduce_q.remote(float(i + 1), "int8")
+                    for i, a in enumerate(rank_actors)], timeout=120)
+    expect = np.full((4,), 1.1 + 2.1 + 3.1, np.float32)
+    for out in outs:
+        np.testing.assert_allclose(out, expect, rtol=0, atol=0.1)

@@ -101,6 +101,44 @@ class TestPrometheusExposition:
                        float(line.rsplit(" ", 1)[1]) > 0
                        for line in lines), name
 
+    def test_compression_series_in_exposition(self):
+        """Golden coverage for the compressed-movement-plane series: the
+        per-codec byte counters, the encode/decode seconds histogram, the
+        skip counter, and the quantized-collective counter must all
+        surface in the exposition once they have moved."""
+        counters = ("rmt_transfer_compress_bytes_in_total",
+                    "rmt_transfer_compress_bytes_out_total",
+                    "rmt_transfer_compress_skipped_total",
+                    "rmt_collective_quantized_ops_total")
+        for name in counters + ("rmt_transfer_compress_seconds",):
+            assert name in mdefs.DEFS, name
+        mdefs.transfer_compress_bytes_in().inc(
+            1 << 20, tags={"codec": "zrle"})
+        mdefs.transfer_compress_bytes_out().inc(
+            1 << 10, tags={"codec": "zrle"})
+        mdefs.transfer_compress_skipped().inc(
+            tags={"reason": "incompressible"})
+        mdefs.collective_quantized_ops().inc(
+            tags={"op": "allreduce", "precision": "int8"})
+        mdefs.transfer_compress_seconds().observe(
+            0.01, tags={"codec": "zrle", "op": "encode"})
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in counters:
+            assert f"# TYPE {name} counter" in lines, name
+            assert any(line.startswith(f"# HELP {name} ") and
+                       len(line) > len(f"# HELP {name} ")
+                       for line in lines), name
+            assert any(line.startswith(name) and
+                       float(line.rsplit(" ", 1)[1]) > 0
+                       for line in lines), name
+        assert "# TYPE rmt_transfer_compress_seconds histogram" in lines
+        assert any(line.startswith(
+            'rmt_transfer_compress_seconds_count{codec="zrle",op="encode"}')
+            for line in lines)
+        assert ('rmt_collective_quantized_ops_total'
+                '{op="allreduce",precision="int8"}') in text
+
     def test_canonical_defs_construct(self):
         """Every declared instrument is constructible and re-entrant
         (aliases prior storage instead of shadowing it)."""
