@@ -5,6 +5,12 @@ JAX/XLA/Pallas — see SURVEY.md for the blueprint."""
 
 __version__ = "0.1.0"
 
+# RMT_LOCK_CHECK=1 patches threading.Lock/RLock with the lock-order
+# recorder BEFORE any runtime lock exists (api/init below creates them)
+from .analysis import lockwatch as _lockwatch  # noqa: E402
+
+_lockwatch.maybe_install_from_env()
+
 from .api import (  # noqa: F401
     init, shutdown, is_initialized, remote, get, put, wait, kill, cancel,
     get_actor, method, ObjectRef, nodes, cluster_resources,

@@ -1,0 +1,23 @@
+"""rmtcheck: AST static analysis + runtime race/deadlock detection for
+the runtime's concurrency and registry conventions.
+
+Static suite: ``python -m ray_memory_management_tpu.analysis`` or
+``rmt check`` — see ``engine.run_checks`` and ``analysis/README.md``.
+Runtime detector: ``lockwatch`` (opt-in via ``RMT_LOCK_CHECK=1``).
+"""
+
+from .engine import Violation, all_rules, run_checks  # noqa: F401
+
+__all__ = ["Violation", "all_rules", "run_checks", "run_default"]
+
+
+def run_default(frozen: bool = False, rules=None):
+    """Run the suite against the in-tree package + tests (the paths the
+    CLI and tier-1 test use)."""
+    import os
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    tests = os.path.join(repo, "tests")
+    return run_checks(pkg, tests, rules=rules,
+                      options={"frozen": frozen})

@@ -1,0 +1,311 @@
+"""Opt-in runtime lock-order / blocking-call detector (``RMT_LOCK_CHECK=1``).
+
+A ``threading.settrace``-free complement to the static checkers: static
+analysis sees lexical ``with`` nesting, but lock-ORDER inversions only
+exist across threads at runtime (thread A takes L1 then L2, thread B
+takes L2 then L1 — each order is locally fine, together they deadlock).
+
+Mechanism: ``install()`` monkeypatches ``threading.Lock`` /
+``threading.RLock`` with a factory that wraps locks CREATED from package
+code (creation frame filtered by filename; frames inside the
+``threading`` module are skipped so a ``Condition()``'s internal RLock
+is attributed to the real caller). Each wrapper records, per thread, the
+stack of held lock SITES (``file:line`` of creation — site-keyed, so
+10k per-connection locks from one constructor collapse into one graph
+node). On every acquire, an edge ``held-site -> new-site`` is added to a
+global order graph; ``report()`` runs Tarjan SCC over it and returns the
+inversion cycles. ``time.sleep`` is also wrapped: sleeping while holding
+any watched lock is recorded as a blocking-under-lock event (the runtime
+twin of the static ``blocking-under-lock`` rule).
+
+Overhead budget (soaks assert <= 5%): the hot path is one thread-local
+list append plus a lock-free ``(a, b) in edges`` membership test —
+the bookkeeping mutex is only taken for a NEW edge, which happens
+O(distinct-pairs) times, not O(acquisitions).
+
+Condition-variable compatibility: the wrapper ``__getattr__``-delegates
+everything else (``_release_save`` / ``_acquire_restore`` /
+``_is_owned``) to the inner lock, so ``Condition(wrapped_lock).wait()``
+releases the INNER lock directly. The held stack deliberately keeps its
+entry across the wait: the thread is parked and acquires nothing, and
+the reacquire on wakeup restores the real state the stack describes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_SLEEP = time.sleep
+
+_PKG_MARKER = "ray_memory_management_tpu"
+_SELF_FILE = os.path.abspath(__file__)
+# path substrings whose frames count as "ours" for lock creation; tests
+# extend this via install(markers=...) to watch locks they create
+_markers: Tuple[str, ...] = (_PKG_MARKER,)
+
+# all state guarded by _mu (a REAL lock: never wrapped, never in the graph)
+_mu = _REAL_LOCK()
+_edges: Set[Tuple[str, str]] = set()
+_edge_examples: Dict[Tuple[str, str], str] = {}   # edge -> thread name
+_blocking: List[dict] = []
+_locks_watched = 0
+_acquisitions = 0
+_installed = False
+
+_tls = threading.local()
+
+
+def _held_stack() -> List[Tuple[str, int]]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _creation_site() -> Optional[str]:
+    """file:line of the package frame creating a lock, or None when the
+    lock belongs to foreign code (stdlib, test harness internals)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        mod = f.f_globals.get("__name__", "")
+        if mod == "threading" or mod.startswith("threading.") or \
+                os.path.abspath(fn) == _SELF_FILE:
+            f = f.f_back
+            continue
+        for marker in _markers:
+            if marker in fn:
+                rel = fn.split(marker, 1)[-1].lstrip(os.sep + "/")
+                return f"{os.path.basename(marker)}/{rel}:{f.f_lineno}"
+        return None
+    return None
+
+
+class _WatchedLock:
+    """Wraps one Lock/RLock; tracks held-site order per thread."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def _record_acquire(self) -> None:
+        global _acquisitions
+        stack = _held_stack()
+        me = id(self._inner)
+        for held_site, held_id in stack:
+            if held_site == self._site or held_id == me:
+                continue  # reentrant / same creation site: not an order
+            edge = (held_site, self._site)
+            if edge not in _edges:       # lock-free fast path
+                with _mu:
+                    if edge not in _edges:
+                        _edges.add(edge)
+                        _edge_examples[edge] = \
+                            threading.current_thread().name
+        stack.append((self._site, me))
+        _acquisitions += 1               # GIL-atomic, diagnostic only
+
+    def release(self) -> None:
+        self._inner.release()
+        stack = _held_stack()
+        me = id(self._inner)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == me:
+                del stack[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # Condition internals (_release_save/_acquire_restore/_is_owned)
+        # and anything else go straight to the real lock
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<WatchedLock {self._site} of {self._inner!r}>"
+
+
+def _make_factory(real_ctor):
+    def factory(*args, **kwargs):
+        global _locks_watched
+        inner = real_ctor(*args, **kwargs)
+        site = _creation_site()
+        if site is None:
+            return inner
+        _locks_watched += 1
+        return _WatchedLock(inner, site)
+    return factory
+
+
+def _watched_sleep(seconds):
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        with _mu:
+            _blocking.append({
+                "call": "time.sleep",
+                "seconds": seconds,
+                "held": [s for s, _ in stack],
+                "thread": threading.current_thread().name,
+            })
+    return _REAL_SLEEP(seconds)
+
+
+def install(markers=None) -> None:
+    """Patch threading.Lock/RLock + time.sleep. Idempotent. Must run
+    BEFORE the runtime creates its locks (the package __init__ calls
+    maybe_install_from_env() for exactly this reason). ``markers``:
+    extra path substrings whose frames count as package code (tests use
+    this to watch locks they create themselves)."""
+    global _installed, _markers
+    if markers:
+        _markers = (_PKG_MARKER,) + tuple(markers)
+    if _installed:
+        return
+    threading.Lock = _make_factory(_REAL_LOCK)
+    threading.RLock = _make_factory(_REAL_RLOCK)
+    time.sleep = _watched_sleep
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed, _markers
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    time.sleep = _REAL_SLEEP
+    _markers = (_PKG_MARKER,)
+    _installed = False
+
+
+def reset() -> None:
+    global _locks_watched, _acquisitions
+    with _mu:
+        _edges.clear()
+        _edge_examples.clear()
+        del _blocking[:]
+    _locks_watched = 0
+    _acquisitions = 0
+
+
+def installed() -> bool:
+    return _installed
+
+
+def _scc_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Tarjan SCC; returns components of size > 1 plus self-loops —
+    i.e. the lock-order-inversion cycles."""
+    adj: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        nodes.add(a)
+        nodes.add(b)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (soak graphs are small but recursion limits
+        # are not ours to burn)
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or (node, node) in edges:
+                    out.append(sorted(comp))
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return out
+
+
+def report() -> dict:
+    """{"cycles": [[site,...]], "edges": n, "blocking_under_lock": [...],
+    "locks_watched": n, "acquisitions": n}. A non-empty ``cycles`` means
+    two threads take the same pair of locks in opposite orders."""
+    with _mu:
+        edges = set(_edges)
+        blocking = list(_blocking)
+    return {
+        "cycles": _scc_cycles(edges),
+        "edges": sorted(f"{a} -> {b}" for a, b in edges),
+        "blocking_under_lock": blocking,
+        "locks_watched": _locks_watched,
+        "acquisitions": _acquisitions,
+    }
+
+
+@contextlib.contextmanager
+def watching(markers=None):
+    """Install + reset, yield the module (call ``report()`` inside),
+    uninstall on exit. The soak-test entry point."""
+    install(markers=markers)
+    reset()
+    try:
+        yield sys.modules[__name__]
+    finally:
+        uninstall()
+
+
+def maybe_install_from_env() -> bool:
+    """Install when RMT_LOCK_CHECK=1 — called from the package __init__
+    so patching precedes every lock the runtime creates."""
+    if os.environ.get("RMT_LOCK_CHECK", "") == "1":
+        install()
+        return True
+    return False

@@ -64,7 +64,7 @@ class Pubsub:
     collapse to direct callbacks in-process)."""
 
     def __init__(self):
-        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
@@ -90,22 +90,22 @@ class GCS:
         # redis_store_client.h:28): durable backends persist the internal KV
         # and detached-actor specs across head restarts
         self.storage = storage or InMemoryGcsStorage()
-        self.nodes: Dict[NodeID, NodeInfo] = {}
-        self.actors: Dict[ActorID, ActorRecord] = {}
-        self.named_actors: Dict[str, ActorID] = {}
-        self.placement_groups: Dict[Any, Any] = {}
-        self.jobs: Dict[Any, dict] = {}
-        self.kv: Dict[str, bytes] = {
+        self.nodes: Dict[NodeID, NodeInfo] = {}  # guarded-by: _lock
+        self.actors: Dict[ActorID, ActorRecord] = {}  # guarded-by: _lock
+        self.named_actors: Dict[str, ActorID] = {}  # guarded-by: _lock
+        self.placement_groups: Dict[Any, Any] = {}  # guarded-by: _lock
+        self.jobs: Dict[Any, dict] = {}  # guarded-by: _lock
+        self.kv: Dict[str, bytes] = {  # guarded-by: _lock
             k: v for k, v in self.storage.items("kv")}
         self.pubsub = Pubsub()
         # object directory: object_id bytes -> set of NodeID with a sealed copy
-        self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
+        self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)  # guarded-by: _lock
         # payload sizes alongside the directory (the reference's object
         # directory carries object_size for exactly this reason:
         # locality-aware leasing needs bytes, not just holder sets).
         # Entries live and die with object_locations.
-        self.object_sizes: Dict[bytes, int] = {}
-        self._node_index = 0
+        self.object_sizes: Dict[bytes, int] = {}  # guarded-by: _lock
+        self._node_index = 0  # guarded-by: _lock
 
     # -- jobs ----------------------------------------------------------------
     # The job table (GcsJobManager analog, gcs_job_manager.h:28): one row

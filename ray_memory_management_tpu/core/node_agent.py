@@ -152,10 +152,10 @@ class NodeAgent:
         self._socket_path = f"/tmp/rmtA_{os.getpid()}_{os.urandom(4).hex()}.sock"
         self._listener = Listener(self._socket_path, family="AF_UNIX")
         os.chmod(self._socket_path, 0o600)
-        self._workers: Dict[bytes, Any] = {}        # wid -> conn
-        self._worker_procs: Dict[bytes, Any] = {}   # wid -> Popen
-        self._pending_bootstrap: Dict[bytes, dict] = {}  # cold-spawn tokens
-        self._worker_send_locks: Dict[bytes, threading.Lock] = {}
+        self._workers: Dict[bytes, Any] = {}        # wid -> conn  # guarded-by: _lock
+        self._worker_procs: Dict[bytes, Any] = {}   # wid -> Popen  # guarded-by: _lock
+        self._pending_bootstrap: Dict[bytes, dict] = {}  # cold-spawn tokens  # guarded-by: _lock
+        self._worker_send_locks: Dict[bytes, threading.Lock] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # The object plane runs on its OWN thread: a push/ensure into a
@@ -163,8 +163,8 @@ class NodeAgent:
         # starve liveness pings, task dispatch (wsend), or the obj_free
         # frames that drain capacity. FIFO per-frame ordering within the
         # plane (push -> chunk -> seal) is preserved by the single queue.
-        self._obj_q: deque = deque()
-        self._obj_q_bytes = 0  # payload bytes admitted (accounted at push)
+        self._obj_q: deque = deque()  # guarded-by: _obj_cond
+        self._obj_q_bytes = 0  # payload bytes admitted (accounted at push)  # guarded-by: _obj_cond
         # cap on queued payload so a blocked store never buffers an entire
         # multi-GB transfer backlog in agent RAM. The recv loop must NEVER
         # park on this: while parked it stops reading ping and obj_free —
@@ -178,13 +178,18 @@ class NodeAgent:
         # bytes by admission the same way, pull_manager.h:47).
         self._obj_q_limit = max(64 << 20,
                                 4 * self.config.object_manager_chunk_size)
-        self._push_acct: Dict[bytes, int] = {}  # oid -> unaccounted bytes
-        self._dropped_pushes: Dict[bytes, bool] = {}  # oid -> nack pending
+        self._push_acct: Dict[bytes, int] = {}  # oid -> unaccounted bytes  # guarded-by: _obj_cond
+        # push-lifecycle markers are mutated from BOTH the recv thread
+        # (admission/nack) and the object-plane thread (full store,
+        # seal): their mutex is _free_mu, which already serializes the
+        # free-vs-push decisions they feed. Lock order: _obj_cond may
+        # nest _free_mu inside it, never the reverse.
+        self._dropped_pushes: Dict[bytes, bool] = {}  # oid -> nack pending  # guarded-by: _free_mu
         # pushes whose create hit a transiently-full store: _obj_seal acks
         # these "retryable" so the head backs off and re-pushes while its
         # source read ref keeps the object live (admission control, never
         # object loss — pull_manager.h:47 / create_request_queue.h:32)
-        self._full_pushes: Dict[bytes, bool] = {}
+        self._full_pushes: Dict[bytes, bool] = {}  # guarded-by: _free_mu
         self._obj_cond = threading.Condition()
         # frees that arrived while a push of the same object was still
         # queued/mid-flight: consumed by _obj_push/_obj_seal so the freed
@@ -192,7 +197,7 @@ class NodeAgent:
         # the free's contains-or-mark and the seal's mark-or-seal decisions
         # atomic against each other (recv thread vs object-plane thread);
         # dict (insertion-ordered) so overflow evicts the STALEST marker
-        self._freed_while_pushing: Dict[bytes, bool] = {}
+        self._freed_while_pushing: Dict[bytes, bool] = {}  # guarded-by: _free_mu
         self._free_mu = threading.Lock()
         # warm the fork server while the node is idle: the first actor
         # burst should never pay the zygote's preload
@@ -384,9 +389,6 @@ class NodeAgent:
         except ValueError:
             pass  # already sealed in the store: ignore this push's chunks
         except ObjectStoreFullError:
-            while len(self._full_pushes) > 4096:
-                self._full_pushes.pop(next(iter(self._full_pushes)))
-            self._full_pushes[oid] = True  # _obj_seal acks retryable
             # nack NOW as well (the push frame carries req): the head's
             # chunk loop aborts on the early ack instead of streaming the
             # whole payload per retry; mark the push dropped so the recv
@@ -394,7 +396,11 @@ class NodeAgent:
             # already be queued on this plane — _full_pushes answers it
             # retryable too (the head ignores the duplicate ack: its
             # request state was popped by the first one).
-            self._dropped_pushes[oid] = True
+            with self._free_mu:
+                while len(self._full_pushes) > 4096:
+                    self._full_pushes.pop(next(iter(self._full_pushes)))
+                self._full_pushes[oid] = True  # _obj_seal acks retryable
+                self._dropped_pushes[oid] = True
             try:
                 self._send({
                     "type": "push_ack", "req": msg["req"],
@@ -688,17 +694,19 @@ class NodeAgent:
                         # a stale dropped-marker from an earlier nacked
                         # attempt must not swallow this admitted push's
                         # chunks (and leak its admitted bytes forever)
-                        self._dropped_pushes.pop(oid, None)
+                        with self._free_mu:
+                            self._dropped_pushes.pop(oid, None)
                         if not dup:
                             self._push_acct[oid] = msg["size"]
                             self._obj_q_bytes += msg["size"]
                         self._obj_q.append(msg)
                         self._obj_cond.notify()
                 if over:
-                    while len(self._dropped_pushes) > 4096:
-                        self._dropped_pushes.pop(
-                            next(iter(self._dropped_pushes)))
-                    self._dropped_pushes[oid] = True
+                    with self._free_mu:
+                        while len(self._dropped_pushes) > 4096:
+                            self._dropped_pushes.pop(
+                                next(iter(self._dropped_pushes)))
+                        self._dropped_pushes[oid] = True
                     # nack NOW (the push frame carries req): the head's
                     # chunk loop aborts on the early ack instead of
                     # streaming the whole payload just to be discarded
@@ -718,7 +726,8 @@ class NodeAgent:
                 # ADMITTED before being dropped (the full-store early
                 # nack drops mid-stream: without this the admitted bytes
                 # leak and the plane budget shrinks permanently)
-                self._dropped_pushes.pop(msg["oid"], None)
+                with self._free_mu:
+                    self._dropped_pushes.pop(msg["oid"], None)
                 with self._obj_cond:
                     self._obj_q_bytes -= self._push_acct.pop(msg["oid"], 0)
             elif t in ("obj_chunk", "obj_seal", "obj_pull",

@@ -268,13 +268,17 @@ class RemoteNodeManager(NodeManager):
                 with self._pending_lock:
                     self._pending.pop(req, None)
                 return False, "channel send failed"
-            if not state["event"].wait(timeout):
-                with self._pending_lock:
-                    self._pending.pop(req, None)
-                return False, "timeout"
+        # ack wait OUTSIDE _push_lock: the lock only exists to keep the
+        # push/chunk/seal frame sequence unfragmented on the channel —
+        # holding it across a (up to 120s) ack wait convoys every other
+        # push to this node behind one slow store
+        if not state["event"].wait(timeout):
             with self._pending_lock:
                 self._pending.pop(req, None)
-            return state["error"] is None, state["error"]
+            return False, "timeout"
+        with self._pending_lock:
+            self._pending.pop(req, None)
+        return state["error"] is None, state["error"]
 
     def ensure_object(self, object_id: bytes, timeout: float = 60.0) -> bool:
         """Ask the agent to make the object shm-resident (restoring from its

@@ -393,10 +393,10 @@ class Runtime:
         # pending future (a task future must outlive frees for its
         # waiters; a freed promise means the caller is gone and a late
         # external resolution must be dropped, not stored ownerless)
-        self._promises: Set[bytes] = set()
-        self.tasks: Dict[bytes, _TaskRecord] = {}
-        self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id
-        self.local_refs: Dict[bytes, int] = defaultdict(int)
+        self._promises: Set[bytes] = set()  # guarded-by: _lock
+        self.tasks: Dict[bytes, _TaskRecord] = {}  # guarded-by: _lock
+        self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id  # guarded-by: _lock
+        self.local_refs: Dict[bytes, int] = defaultdict(int)  # guarded-by: _ref_mu
         # dedicated refcount shard: ObjectRef __del__/__init__ storms on
         # the APPLICATION thread must not contend with the router's
         # dispatch/completion work under the big runtime lock (the
@@ -407,16 +407,16 @@ class Runtime:
         self.actors: Dict[bytes, _ActorInfo] = {}
         self.fn_blobs: Dict[bytes, bytes] = {}
         self.cls_blobs: Dict[bytes, bytes] = {}
-        self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids
-        self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)
-        self._pending_schedule: deque = deque()
-        self._deferred_frees: List[bytes] = []  # zero-ref batch buffer
+        self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids  # guarded-by: _lock
+        self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)  # guarded-by: _lock
+        self._pending_schedule: deque = deque()  # guarded-by: _lock
+        self._deferred_frees: List[bytes] = []  # zero-ref batch buffer  # guarded-by: _ref_mu
         # decentralized ownership bookkeeping (reference_count.h:39-61):
         # per-worker borrow pins (each holds one local_refs count until
         # the worker releases or dies) and per-worker owned-put
         # attribution (objects whose owner is the producing worker)
-        self._worker_borrows: Dict[bytes, set] = {}
-        self._worker_owned: Dict[bytes, set] = {}
+        self._worker_borrows: Dict[bytes, set] = {}  # guarded-by: _lock
+        self._worker_owned: Dict[bytes, set] = {}  # guarded-by: _lock
         # lineage pinning (reference_count.h lineage refcounting): how many
         # RETAINED task records list this oid as a ref arg. A producer's
         # record/lineage can only be pruned when no downstream record still
@@ -469,7 +469,7 @@ class Runtime:
         # broadcast distribution gate: per-oid in-flight pull count +
         # wakeup when a pull lands (a NEW holder exists to pull from)
         self._bcast_cond = threading.Condition()
-        self._oid_pulls: Dict[bytes, int] = {}
+        self._oid_pulls: Dict[bytes, int] = {}  # guarded-by: _bcast_cond
         import socket as _socket
 
         self._hostname = _socket.gethostname()  # fixed for process life
@@ -536,8 +536,8 @@ class Runtime:
         for i, spec in enumerate(nodes_spec):
             self.add_node(spec, head=(i == 0))
 
-        self._send_cond = threading.Condition()  # guards _send_channels
-        self._send_channels: Dict[Any, _SendChannel] = {}
+        self._send_cond = threading.Condition()
+        self._send_channels: Dict[Any, _SendChannel] = {}  # guarded-by: _send_cond
         self._sender_pool = _SenderPool(self)
         self._router = threading.Thread(
             target=self._router_loop, daemon=True, name="rmt-router"
@@ -1258,7 +1258,7 @@ class Runtime:
     def _ref_deps(self, spec: TaskSpec) -> List[bytes]:
         return spec.ref_deps  # cached on the spec (see TaskSpec.ref_deps)
 
-    def _queue_when_deps_ready_locked(self, spec: TaskSpec) -> bool:
+    def _queue_when_deps_ready_locked(self, spec: TaskSpec) -> bool:  # rmtcheck: holds=_lock
         """With self._lock held: either park the task on its unresolved
         deps (LocalDependencyResolver analog, dependency_resolver.h:29) or
         append it to the submit queue for the router's batched scheduling
@@ -1290,7 +1290,7 @@ class Runtime:
         if nudge:
             self._wakeup()
 
-    def _deps_ready_locked(self, oid: bytes) -> bool:
+    def _deps_ready_locked(self, oid: bytes) -> bool:  # rmtcheck: holds=_lock
         """With self._lock held: resolve every task parked on ``oid``,
         queueing newly-unblocked specs for the router's batched scheduling
         pass. Returns True when the caller should nudge the router."""
@@ -3252,7 +3252,7 @@ class Runtime:
         if nudge:
             self._wakeup()
 
-    def _take_deferred_frees_locked(self) -> List[bytes]:
+    def _take_deferred_frees_locked(self) -> List[bytes]:  # rmtcheck: holds=_ref_mu
         """With self._ref_mu held: drain the deferral buffer, SKIPPING
         any oid that picked up a live reference since its count hit zero
         (e.g. a cached ref handed out again, a borrowed bare-id re-pinned
@@ -3272,7 +3272,7 @@ class Runtime:
         if batch:
             self.free_objects(batch)
 
-    def _try_prune_record_locked(self, task_id: bytes) -> None:
+    def _try_prune_record_locked(self, task_id: bytes) -> None:  # rmtcheck: holds=_lock
         """With self._lock held: prune a terminal task's record, futures,
         and lineage edges once nothing can need them again — no live
         handle on any return, no settled-future waiter, and no RETAINED

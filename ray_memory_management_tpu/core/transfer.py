@@ -228,7 +228,7 @@ class TransferServer:
         self._sem = threading.BoundedSemaphore(max_conns)
         self._stop = threading.Event()
         self._conns_mu = threading.Lock()
-        self._conns: set = set()  # live serving connections
+        self._conns: set = set()  # live serving connections  # guarded-by: _conns_mu
         # observability (read by tests/bench; += is GIL-atomic enough for
         # monotonic counters)
         self.connections_accepted = 0
@@ -550,8 +550,8 @@ class ConnectionPool:
     def __init__(self, max_idle_per_peer: int = 8):
         self.max_idle_per_peer = max_idle_per_peer
         self._mu = threading.Lock()
-        self._idle: Dict[tuple, List] = {}
-        self._closed = False
+        self._idle: Dict[tuple, List] = {}  # guarded-by: _mu
+        self._closed = False  # guarded-by: _mu
         self.hits = 0
         self.misses = 0
 

@@ -75,8 +75,8 @@ class _ReplySender:
         self._conn = conn
         self._send_lock = threading.Lock()
         self._cond = threading.Condition()
-        self._q: deque = deque()
-        self._thread: Optional[threading.Thread] = None
+        self._q: deque = deque()  # guarded-by: _cond
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _cond
 
     def send(self, msg: dict) -> None:
         with self._cond:
@@ -128,12 +128,12 @@ class _TaskDispatcher:
     pipelining depth, since only queued tasks trigger growth)."""
 
     def __init__(self):
-        self._q: deque = deque()
+        self._q: deque = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._threads = 0   # live executor threads
-        self._blocked = 0   # parked in an owner wait (proxy request)
-        self._waiting = 0   # idle, parked on the queue
-        self._resuming = 0  # returned from an owner wait, parked for turn
+        self._threads = 0   # live executor threads  # guarded-by: _cond
+        self._blocked = 0   # parked in an owner wait (proxy request)  # guarded-by: _cond
+        self._waiting = 0   # idle, parked on the queue  # guarded-by: _cond
+        self._resuming = 0  # returned from an owner wait, parked for turn  # guarded-by: _cond
         self._is_exec = threading.local()
 
     def _runnable(self) -> int:
@@ -147,7 +147,7 @@ class _TaskDispatcher:
             elif self._runnable() < 1:
                 self._spawn()
 
-    def _spawn(self) -> None:
+    def _spawn(self) -> None:  # rmtcheck: holds=_cond
         self._threads += 1
         threading.Thread(target=self._loop, daemon=True,
                          name="task-exec").start()
@@ -225,9 +225,9 @@ class WorkerRuntimeProxy:
 
     def __init__(self, worker: "Worker"):
         self._worker = worker
-        self._pending: Dict[int, Any] = {}
-        self._events: Dict[int, threading.Event] = {}
-        self._req_counter = 0
+        self._pending: Dict[int, Any] = {}  # guarded-by: _lock
+        self._events: Dict[int, threading.Event] = {}  # guarded-by: _lock
+        self._req_counter = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         # worker-side reference counting (the decentralization seed of
         # the reference's per-worker ReferenceCounter,
@@ -242,12 +242,12 @@ class WorkerRuntimeProxy:
         # RLock: __del__ can fire inside any of these methods (a gc pass
         # collecting a ref cycle) and re-enter remove_local_ref
         self._ref_lock = threading.RLock()
-        self._ref_counts: Dict[bytes, int] = {}
-        self._owned: set = set()      # oids this worker put (owner)
-        self._escaped: set = set()    # owned ids pickled OUT of this worker
-        self._reported: set = set()   # borrows pinned head-side
-        self._release_buf: List[bytes] = []
-        self._owned_drop_buf: List[bytes] = []
+        self._ref_counts: Dict[bytes, int] = {}  # guarded-by: _ref_lock
+        self._owned: set = set()      # oids this worker put (owner)  # guarded-by: _ref_lock
+        self._escaped: set = set()    # owned ids pickled OUT of this worker  # guarded-by: _ref_lock
+        self._reported: set = set()   # borrows pinned head-side  # guarded-by: _ref_lock
+        self._release_buf: List[bytes] = []  # guarded-by: _ref_lock
+        self._owned_drop_buf: List[bytes] = []  # guarded-by: _ref_lock
         self.head_round_trips = 0  # observability: blocking owner RTs
 
     @property

@@ -35,6 +35,9 @@ def lib_path(name: str = "shmstore") -> str:
             "-Wall", "-Werror",
             *sources, "-o", tmp, "-lpthread", "-lrt",
         ]
+        # _LOCK exists to serialize the compile itself; concurrent
+        # callers waiting for the finished .so is the intended behavior
+        # rmtcheck: disable=blocking-under-lock
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)  # atomic wrt concurrent builders
     return out

@@ -85,6 +85,24 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Static-analysis suite (lock discipline, metric/fault registry
+    consistency, wire-protocol additivity, trace propagation). Exits
+    non-zero with ``file:line: rule: message`` output on violations."""
+    from ray_memory_management_tpu.analysis.__main__ import main as check
+
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.frozen:
+        argv.append("--frozen")
+    for r in args.rules or ():
+        argv.extend(["--rule", r])
+    if args.root:
+        argv.extend(["--root", args.root])
+    return check(argv)
+
+
 def cmd_memory(args) -> int:
     """Object summary of the runtime in THIS process (meaningful when
     main() is invoked programmatically inside a driver; the runtime is
@@ -291,6 +309,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--num-cpus", type=int, default=4)
     s.add_argument("--num-tpus", type=int, default=0)
     s.set_defaults(fn=cmd_agent)
+
+    s = sub.add_parser(
+        "check",
+        help="run the rmtcheck static-analysis suite (exit non-zero on "
+             "violations)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable JSON report")
+    s.add_argument("--frozen", action="store_true",
+                   help="fail on new wire-protocol keys instead of "
+                        "auto-registering (CI mode)")
+    s.add_argument("--rule", action="append", dest="rules", metavar="RULE",
+                   help="run only this rule (repeatable)")
+    s.add_argument("--root", default=None, help="repo root to analyze")
+    s.set_defaults(fn=cmd_check)
 
     s = sub.add_parser("memory", help="object store summary")
     s.set_defaults(fn=cmd_memory)

@@ -1,0 +1,13 @@
+"""Fixture schema: ghost_key is registered but no longer observed."""
+
+REQUEST_KEYS = (
+    "ghost_key",
+    "oid",
+    "proto",
+    "trace",
+)
+
+REPLY_KEYS = (
+    "error",
+    "size",
+)

@@ -1,0 +1,12 @@
+"""Fixture: fault-site seeds (unregistered site literal)."""
+
+from ..utils.faults import fire
+
+
+def boom():
+    fire("fixture.fired")
+    fire("fixture.not_registered")  # SEEDED: fault-site
+
+
+def boom_suppressed():
+    fire("fixture.also_not_registered")  # rmtcheck: disable=fault-site

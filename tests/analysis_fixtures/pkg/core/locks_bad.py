@@ -1,0 +1,33 @@
+"""Fixture: lock-discipline + blocking-under-lock seeds."""
+
+import threading
+import time
+
+
+class BadCounters:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.items = []  # guarded-by: _mu
+        self.n = 0  # guarded-by: _mu
+
+    def good(self):
+        with self._mu:
+            self.items.append(1)
+            self.n += 1
+
+    def bad_mutation(self):
+        self.items.append(1)  # SEEDED: lock-discipline
+
+    def bad_sleep(self):
+        with self._mu:
+            time.sleep(0.01)  # SEEDED: blocking-under-lock
+
+    def suppressed_mutation(self):
+        self.n += 1  # rmtcheck: disable=lock-discipline
+
+    def suppressed_sleep(self):
+        with self._mu:
+            time.sleep(0.01)  # rmtcheck: disable=blocking-under-lock
+
+    def held_by_contract(self):  # rmtcheck: holds=_mu
+        self.n += 1  # caller holds _mu: NOT a violation

@@ -1,0 +1,19 @@
+"""Fixture: metric-registry seeds (unknown accessor, undeclared tag)."""
+
+from . import metrics_defs as mdefs
+
+
+def emit_ok():
+    mdefs.fixture_used().inc(tags={"stage": "a"})
+
+
+def emit_unknown_accessor():
+    mdefs.not_a_series().inc()  # SEEDED: metric-registry
+
+
+def emit_bad_tag():
+    mdefs.fixture_used().inc(tags={"color": "red"})  # SEEDED: metric-registry
+
+
+def emit_suppressed():
+    mdefs.also_not_a_series().inc()  # rmtcheck: disable=metric-registry

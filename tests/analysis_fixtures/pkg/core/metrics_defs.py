@@ -1,0 +1,23 @@
+"""Fixture metric registry: one referenced series, one drift series."""
+
+
+class Counter:
+    pass
+
+
+DEFS = {
+    "rmt_fixture_used_total": (Counter, dict(tag_keys=("stage",))),
+    "rmt_fixture_unused_total": (Counter, dict()),  # seeded: drift
+}
+
+
+def get(name):
+    return DEFS[name]
+
+
+def fixture_used():
+    return get("rmt_fixture_used_total")
+
+
+def fixture_unused():
+    return get("rmt_fixture_unused_total")

@@ -1,0 +1,11 @@
+"""Fixture wire protocol: sends new_key (addition, fails frozen) and
+never touches ghost_key (removal, always fails)."""
+
+
+def build_request(oid):
+    req = {"oid": oid, "proto": 2, "new_key": 1, "trace": None}
+    return req
+
+
+def read_reply(hdr):
+    return hdr.get("size"), hdr.get("error")

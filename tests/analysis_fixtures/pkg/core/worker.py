@@ -1,0 +1,17 @@
+"""Fixture: trace-propagation seeds (done frame without a trace field)."""
+
+
+def send_done_bad(conn, result):
+    msg = {"type": "done", "value": result}  # SEEDED: trace-propagation
+    conn.send(msg)
+
+
+def send_done_ok(conn, result, trace_ctx):
+    msg = {"type": "done", "value": result, "trace_ctx": trace_ctx}
+    conn.send(msg)
+
+
+def send_done_suppressed(conn, result):
+    # rmtcheck: disable=trace-propagation
+    msg = {"type": "done", "value": result}
+    conn.send(msg)

@@ -1,0 +1,7 @@
+"""Fixture fault plane: one fired site, one drift site."""
+
+SITES = ("fixture.fired", "fixture.unfired")
+
+
+def fire(site):
+    return None

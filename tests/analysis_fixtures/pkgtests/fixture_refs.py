@@ -1,0 +1,6 @@
+"""Plays the test tree for the fixture package: references exactly one
+fault site, so the OTHER registered site also trips the
+no-test-reference arm (naming it here would defeat the seed — the
+checker substring-matches this whole file)."""
+
+REFERENCED_SITES = ["fixture.fired"]

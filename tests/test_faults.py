@@ -20,6 +20,7 @@ import zlib
 import numpy as np
 import pytest
 
+from ray_memory_management_tpu.analysis import lockwatch
 from ray_memory_management_tpu.config import Config
 from ray_memory_management_tpu.core import metrics_defs as mdefs
 from ray_memory_management_tpu.core.object_store import NodeObjectStore
@@ -241,28 +242,34 @@ def test_crc32_combine_matches_full_pass():
 ])
 def test_transfer_matrix_single_fault_recovers(two_stores, site, mode):
     """One injected fault per (site, mode) on a p2p pull: the unified
-    retry loop must converge to byte-exact delivery."""
+    retry loop must converge to byte-exact delivery. Runs under the
+    lock-order detector: the retry/failover path (server recv threads +
+    client pool) must produce zero inversion cycles."""
     a, b = two_stores
     key = os.urandom(16)
-    srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
-    try:
-        payload = np.arange(2 << 20, dtype=np.uint8).tobytes()
-        a.put_bytes(b"M" * 16, payload)
-        faults.configure(f"{site}:{mode}:max=1:stall=0.2", seed=5)
-        before = mdefs.faults_injected().get(
-            tags={"site": site, "mode": mode})
-        err = fetch_object("127.0.0.1", srv.port, key, b"M" * 16, b, CHUNK,
-                           retry=RetryPolicy(max_attempts=4,
-                                             base_backoff_s=0.01))
-        assert err is None, err
-        assert mdefs.faults_injected().get(
-            tags={"site": site, "mode": mode}) == before + 1
-        view = b.get(b"M" * 16)
-        assert bytes(view) == payload
-        del view
-        b.release(b"M" * 16)
-    finally:
-        srv.close()
+    with lockwatch.watching() as lw:
+        srv = TransferServer(a, authkey=key, chunk_size=CHUNK)
+        try:
+            payload = np.arange(2 << 20, dtype=np.uint8).tobytes()
+            a.put_bytes(b"M" * 16, payload)
+            faults.configure(f"{site}:{mode}:max=1:stall=0.2", seed=5)
+            before = mdefs.faults_injected().get(
+                tags={"site": site, "mode": mode})
+            err = fetch_object("127.0.0.1", srv.port, key, b"M" * 16, b,
+                               CHUNK,
+                               retry=RetryPolicy(max_attempts=4,
+                                                 base_backoff_s=0.01))
+            assert err is None, err
+            assert mdefs.faults_injected().get(
+                tags={"site": site, "mode": mode}) == before + 1
+            view = b.get(b"M" * 16)
+            assert bytes(view) == payload
+            del view
+            b.release(b"M" * 16)
+        finally:
+            srv.close()
+        rep = lw.report()
+    assert rep["cycles"] == [], rep["cycles"]
 
 
 def test_wire_corruption_detected_and_repaired(two_stores):
@@ -514,22 +521,29 @@ def test_gcs_prune_location():
 
 def test_control_dispatch_fault_recovered():
     """Injected dispatch errors ride the unified dispatch retry — every
-    task still completes."""
+    task still completes. Runs under the lock-order detector: the
+    dispatch-retry path across runtime/agent/worker locks must stay
+    inversion-free."""
     import ray_memory_management_tpu as rmt
 
     faults.configure("control.dispatch:error:max=2", seed=21)
-    rt = rmt.init(num_cpus=2)
-    try:
-        @rmt.remote
-        def double(x):
-            return x * 2
+    with lockwatch.watching() as lw:
+        rt = rmt.init(num_cpus=2)
+        try:
+            @rmt.remote
+            def double(x):
+                return x * 2
 
-        out = rmt.get([double.remote(i) for i in range(6)], timeout=120)
-        assert out == [0, 2, 4, 6, 8, 10]
-        assert mdefs.faults_injected().get(
-            tags={"site": "control.dispatch", "mode": "error"}) >= 1
-    finally:
-        rmt.shutdown()
+            out = rmt.get([double.remote(i) for i in range(6)],
+                          timeout=120)
+            assert out == [0, 2, 4, 6, 8, 10]
+            assert mdefs.faults_injected().get(
+                tags={"site": "control.dispatch", "mode": "error"}) >= 1
+        finally:
+            rmt.shutdown()
+        rep = lw.report()
+    assert rep["acquisitions"] > 0, "lock detector saw no runtime locks"
+    assert rep["cycles"] == [], rep["cycles"]
 
 
 def test_worker_exec_fault_rides_task_retry():

@@ -1,0 +1,280 @@
+"""rmtcheck suite: the tree is clean, every rule fires on its seeded
+fixture, pragmas suppress, the CLI contract holds, and the runtime
+lock-order detector works.
+
+Tier-1: a regression that breaks any machine-checked invariant (lock
+discipline, registry consistency, wire-protocol additivity, trace
+propagation) fails HERE, with a file:line message, before it flakes a
+chaos soak.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_memory_management_tpu.analysis import all_rules, run_default, \
+    run_checks
+from ray_memory_management_tpu.analysis import lockwatch
+from ray_memory_management_tpu.analysis.__main__ import REPORT_VERSION, \
+    build_report, main as check_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE_PKG = os.path.join(HERE, "analysis_fixtures", "pkg")
+FIXTURE_TESTS = os.path.join(HERE, "analysis_fixtures", "pkgtests")
+
+RULES = ("blocking-under-lock", "fault-site", "lock-discipline",
+         "metric-registry", "protocol-additivity", "trace-propagation")
+
+
+# --------------------------------------------------------------- the tree
+def test_tree_is_clean():
+    """THE enforcement point: zero violations on the real tree, frozen
+    protocol schema. A failure here names the file:line to fix (or the
+    pragma to add with an audited reason)."""
+    violations = run_default(frozen=True)
+    assert violations == [], "\n" + "\n".join(
+        v.format() for v in violations)
+
+
+def test_all_rules_registered():
+    assert tuple(all_rules()) == RULES
+
+
+# ----------------------------------------------------------- fixture seeds
+@pytest.fixture(scope="module")
+def fixture_violations():
+    vs = run_checks(FIXTURE_PKG, FIXTURE_TESTS, options={"frozen": True})
+    return vs
+
+
+def _hits(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+def test_fixture_lock_discipline_fires(fixture_violations):
+    hits = _hits(fixture_violations, "lock-discipline")
+    assert len(hits) == 1, [v.format() for v in hits]
+    assert hits[0].path.endswith("core/locks_bad.py")
+    assert "self.items" in hits[0].message
+    # suppressed_mutation and held_by_contract produced nothing
+
+
+def test_fixture_blocking_under_lock_fires(fixture_violations):
+    hits = _hits(fixture_violations, "blocking-under-lock")
+    assert len(hits) == 1, [v.format() for v in hits]
+    assert "time.sleep" in hits[0].message
+    assert "_mu" in hits[0].message
+
+
+def test_fixture_metric_registry_fires(fixture_violations):
+    msgs = [v.message for v in _hits(fixture_violations,
+                                     "metric-registry")]
+    assert any("not_a_series" in m for m in msgs)          # unknown accessor
+    assert any("'color'" in m for m in msgs)               # undeclared tag
+    assert any("rmt_fixture_unused_total" in m for m in msgs)  # drift
+    assert not any("also_not_a_series" in m for m in msgs)  # pragma
+
+
+def test_fixture_fault_site_fires(fixture_violations):
+    msgs = [v.message for v in _hits(fixture_violations, "fault-site")]
+    assert any("fixture.not_registered" in m for m in msgs)
+    assert any("fixture.unfired" in m and "no fire()" in m for m in msgs)
+    assert any("fixture.unfired" in m and "never referenced" in m
+               for m in msgs)
+    assert not any("also_not_registered" in m for m in msgs)  # pragma
+
+
+def test_fixture_protocol_additivity_fires(fixture_violations):
+    msgs = [v.message for v in _hits(fixture_violations,
+                                     "protocol-additivity")]
+    assert any("'ghost_key'" in m and "no longer" in m for m in msgs)
+    assert any("'new_key'" in m and "not registered" in m for m in msgs)
+
+
+def test_fixture_trace_propagation_fires(fixture_violations):
+    hits = _hits(fixture_violations, "trace-propagation")
+    assert len(hits) == 1, [v.format() for v in hits]
+    assert "send_done_bad" in hits[0].message
+    # send_done_ok carries trace_ctx; send_done_suppressed has the pragma
+
+
+def test_protocol_disable_file_pragma(tmp_path):
+    """disable-file suppresses a whole-file rule (protocol violations
+    anchor at line 1, so the file pragma is the suppression story)."""
+    core = tmp_path / "core"
+    core.mkdir()
+    (core / "transfer.py").write_text(
+        "# rmtcheck: disable-file=protocol-additivity\n"
+        "def build(oid):\n"
+        "    return {'oid': oid, 'proto': 2, 'trace': None}\n")
+    ana = tmp_path / "analysis"
+    ana.mkdir()
+    (ana / "protocol_schema.py").write_text(
+        "REQUEST_KEYS = ('ghost', 'oid', 'proto', 'trace')\n"
+        "REPLY_KEYS = ()\n")
+    vs = run_checks(str(tmp_path), None,
+                    rules=["protocol-additivity"],
+                    options={"frozen": True})
+    assert vs == [], [v.format() for v in vs]
+
+
+# ------------------------------------------------------------ CLI contract
+REQUIRED_REPORT_FIELDS = ("version", "frozen", "rules", "files_scanned",
+                          "violation_count", "counts_by_rule",
+                          "violations")
+REQUIRED_VIOLATION_FIELDS = ("rule", "path", "line", "message")
+
+
+def test_json_report_contract(fixture_violations):
+    report = build_report(fixture_violations, list(RULES), 9, True)
+    missing = [k for k in REQUIRED_REPORT_FIELDS if k not in report]
+    assert not missing, f"report missing {missing}"
+    assert report["version"] == REPORT_VERSION
+    assert report["violation_count"] == len(fixture_violations) > 0
+    assert sum(report["counts_by_rule"].values()) == \
+        report["violation_count"]
+    for v in report["violations"]:
+        vmissing = [k for k in REQUIRED_VIOLATION_FIELDS if k not in v]
+        assert not vmissing, f"violation missing {vmissing}"
+    json.loads(json.dumps(report))  # round-trips
+
+
+def test_cli_exit_nonzero_with_file_line_output(capsys):
+    rc = check_main(["--frozen",
+                     "--root", os.path.join(HERE, "analysis_fixtures")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    # file:line: rule: message lines
+    assert "core/locks_bad.py:" in out
+    assert "lock-discipline:" in out
+
+
+def test_cli_exit_zero_on_clean_tree(capsys):
+    assert check_main(["--frozen"]) == 0
+    payload = json.loads("{}")  # keep flake quiet about unused capsys
+    del payload
+    capsys.readouterr()
+
+
+def test_cli_json_mode(capsys):
+    rc = check_main(["--json", "--frozen",
+                     "--root", os.path.join(HERE, "analysis_fixtures")])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["violation_count"] > 0
+    assert report["frozen"] is True
+
+
+# ------------------------------------------------------- runtime lockwatch
+def test_lockwatch_detects_inversion():
+    with lockwatch.watching(markers=[HERE]) as lw:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        # run sequentially on two threads: each order is locally fine,
+        # together they form the inversion cycle a<->b
+        for fn in (order_ab, order_ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        rep = lw.report()
+    assert rep["locks_watched"] >= 2
+    assert rep["acquisitions"] >= 4
+    assert len(rep["cycles"]) == 1, rep
+    assert len(rep["cycles"][0]) == 2
+
+
+def test_lockwatch_no_false_cycle_on_consistent_order():
+    with lockwatch.watching(markers=[HERE]) as lw:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def consistent():
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+
+        threads = [threading.Thread(target=consistent) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rep = lw.report()
+    assert rep["cycles"] == [], rep
+    assert "a" not in rep  # report shape sanity: only documented keys
+
+
+def test_lockwatch_records_sleep_under_lock():
+    with lockwatch.watching(markers=[HERE]) as lw:
+        mu = threading.Lock()
+        with mu:
+            time.sleep(0.001)
+        rep = lw.report()
+    assert rep["blocking_under_lock"], rep
+    assert rep["blocking_under_lock"][0]["call"] == "time.sleep"
+
+
+def test_lockwatch_condition_protocol_works():
+    """Condition(wrapped_lock).wait/notify round-trips — the wrapper
+    delegates _release_save/_acquire_restore to the inner lock."""
+    with lockwatch.watching(markers=[HERE]) as lw:
+        mu = threading.Lock()
+        cond = threading.Condition(mu)
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5.0)
+                hits.append("woke")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            hits.append("go")
+            cond.notify()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert hits == ["go", "woke"]
+        rep = lw.report()
+    assert rep["cycles"] == [], rep
+
+
+def test_lockwatch_overhead_is_negligible_for_soak_like_work():
+    """Soaks are IO/sleep-dominated; the wrapper's per-acquire cost must
+    vanish in that profile (the <=5% soak-overhead budget). Measured on
+    a workload of lock-guarded queue ops interleaved with tiny sleeps."""
+    def workload():
+        mu = threading.Lock()
+        q = []
+        t0 = time.perf_counter()
+        for i in range(200):
+            with mu:
+                q.append(i)
+                if len(q) > 64:
+                    del q[:32]
+            if i % 20 == 0:
+                time.sleep(0.001)
+        return time.perf_counter() - t0
+
+    base = min(workload() for _ in range(3))
+    with lockwatch.watching(markers=[HERE]):
+        watched = min(workload() for _ in range(3))
+    # generous ceiling to keep CI deterministic; typical measured
+    # overhead on this profile is well under 5%
+    assert watched <= base * 1.25, (watched, base)

@@ -28,6 +28,7 @@ from ray_memory_management_tpu.train import (
     ScalingConfig,
     verify_checkpoint_dir,
 )
+from ray_memory_management_tpu.analysis import lockwatch
 from ray_memory_management_tpu.utils import faults
 
 
@@ -462,8 +463,14 @@ def test_chaos_soak_train_survives_node_kill(tmp_path):
     """The tentpole acceptance: SIGKILL a training-worker's node agent
     mid-fit(). The run must complete, lose at most one checkpoint
     interval of progress (visible as re-executed steps), and the elastic
-    world size must dip below 2 and recover."""
-    res, killer, (downs, ups), steps = _run_soak(tmp_path, "sigkill")
+    world size must dip below 2 and recover. Runs under the lock-order
+    detector: the node loss + re-shard + grow-back path must produce
+    zero lock-order-inversion cycles."""
+    with lockwatch.watching() as lw:
+        res, killer, (downs, ups), steps = _run_soak(tmp_path, "sigkill")
+        rep = lw.report()
+    assert rep["acquisitions"] > 0, "lock detector saw no runtime locks"
+    assert rep["cycles"] == [], rep["cycles"]
     assert killer.kills, "chaos harness never fired"
     assert res.error is None, res.error
     got = [m["step"] for m in res.metrics_history]
@@ -485,9 +492,14 @@ def test_chaos_soak_train_survives_node_kill(tmp_path):
 def test_chaos_soak_train_short_stall_is_gray_failure(tmp_path):
     """SIGSTOP an agent briefly (below the death deadline): the classic
     gray failure must cost ZERO progress — no restart, no resize, every
-    step reported exactly once."""
-    res, killer, (downs, ups), steps = _run_soak(tmp_path, "stall",
-                                                 stall_s=1.0)
+    step reported exactly once. Runs under the lock-order detector:
+    the stall/heartbeat-suspect path must stay inversion-free."""
+    with lockwatch.watching() as lw:
+        res, killer, (downs, ups), steps = _run_soak(tmp_path, "stall",
+                                                     stall_s=1.0)
+        rep = lw.report()
+    assert rep["acquisitions"] > 0, "lock detector saw no runtime locks"
+    assert rep["cycles"] == [], rep["cycles"]
     assert killer.stalls, "chaos harness never fired"
     assert res.error is None, res.error
     got = [m["step"] for m in res.metrics_history]
