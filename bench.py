@@ -440,6 +440,40 @@ def _tracing_suite():
         return {"error": repr(e)}
 
 
+# log-plane-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): tasks/s on a one-line-
+# print fan-out with structured capture on (RMT_LOGS=1) vs off, and the
+# overhead percentage the ISSUE caps at 5%.
+REQUIRED_LOGGING_FIELDS = (
+    "logging_on_tasks_per_s", "logging_off_tasks_per_s",
+    "logging_overhead_pct", "n_tasks", "trials",
+)
+
+
+def _logging_suite():
+    """Log-plane overhead (utils/logging_bench.py); fault-isolated so
+    a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.logging_bench import (
+            run_logging_suite,
+        )
+
+        out = run_logging_suite()
+        print(
+            f"  logging fan-out ({out['n_tasks']} one-print tasks): "
+            f"{out['logging_on_tasks_per_s']:.0f} tasks/s on vs "
+            f"{out['logging_off_tasks_per_s']:.0f} off "
+            f"({out['logging_overhead_pct']:+.1f}% overhead)",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_LOGGING_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  logging suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 # Elastic-training contract surfaced in BENCH_DETAIL.json
 # (tests/test_bench_format.py enforces the set): steps/s with durability
 # off/sync/async, the step-blocking slice of one save in each mode (the
@@ -601,6 +635,7 @@ def main() -> None:
     compression = _compression_suite()
     locality = _locality_suite()
     tracing = _tracing_suite()
+    logging_out = _logging_suite()
     elastic = _elastic_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
@@ -612,7 +647,8 @@ def main() -> None:
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
               "transfer": transfer, "compression": compression,
               "locality": locality,
-              "tracing": tracing, "elastic": elastic,
+              "tracing": tracing, "logging": logging_out,
+              "elastic": elastic,
               "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -624,19 +660,19 @@ def main() -> None:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "tpu", "transfer",
                     "compression", "locality",
-                    "tracing", "elastic", "metrics"):
+                    "tracing", "logging", "elastic", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
-                        compression))
+                        compression, logging=logging_out))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
-                  elastic=None, compression=None):
+                  elastic=None, compression=None, logging=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -684,6 +720,11 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
         # the trace-plane acceptance number: fan-out overhead (<=5%)
         line["tracing"] = {
             "overhead_pct": tracing["tracing_overhead_pct"],
+        }
+    if logging and "error" not in logging:
+        # the log-plane acceptance number: chatty fan-out overhead (<=5%)
+        line["logging"] = {
+            "overhead_pct": logging["logging_overhead_pct"],
         }
     if compression and "error" not in compression:
         # the compressed-plane acceptance numbers: best-corpus speedup of
@@ -735,8 +776,8 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("compression", "elastic", "tracing", "locality",
-                  "transfer", "micro", "scale"):
+        for k in ("compression", "elastic", "logging", "tracing",
+                  "locality", "transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

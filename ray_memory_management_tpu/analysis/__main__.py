@@ -57,8 +57,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # importing the checkers registers the rules
     from . import (  # noqa: F401
-        check_faults, check_locks, check_metrics, check_protocol,
-        check_trace,
+        check_faults, check_locks, check_logs, check_metrics,
+        check_protocol, check_trace,
     )
     if args.list_rules:
         for r in all_rules():

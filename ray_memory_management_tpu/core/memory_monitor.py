@@ -13,6 +13,10 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional, Tuple
 
+from ..utils import structlog
+
+log = structlog.get_logger(__name__)
+
 
 def system_memory_usage() -> Tuple[int, int]:
     """(used_bytes, total_bytes) from /proc/meminfo — available-based,
@@ -61,9 +65,6 @@ class MemoryMonitor:
         return total > 0 and used / total >= self.usage_threshold
 
     def _loop(self) -> None:
-        import logging
-
-        log = logging.getLogger(__name__)
         while not self._stop.is_set():
             try:
                 if self.is_over_threshold():

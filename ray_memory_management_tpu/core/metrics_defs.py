@@ -210,6 +210,25 @@ DEFS: Dict[str, tuple] = {
                     "(oldest-first) before they could be dumped; counted "
                     "in whichever process dropped them and merged into "
                     "the head registry via the flush channel.")),
+    # log plane (utils/structlog.py)
+    "rmt_logs_records_total": (Counter, dict(
+        description="Structured log records captured, by source stream "
+                    "(logging bridge vs the stdout/stderr tee); counted "
+                    "at emit time in whichever process captured them.",
+        tag_keys=("stream",))),
+    "rmt_logs_bytes_total": (Counter, dict(
+        description="Structured log message bytes captured (payload "
+                    "text only, excluding the record envelope).")),
+    "rmt_logs_dropped_total": (Counter, dict(
+        description="Log records dropped oldest-first: buffer_full is "
+                    "the worker-side bounded queue overflowing under "
+                    "backpressure, retention is head-side LogStore ring "
+                    "eviction.",
+        tag_keys=("reason",))),
+    "rmt_logs_flush_seconds": (Histogram, dict(
+        description="Worker-side log batch drain time per flush frame "
+                    "(done reply, ticker, or exit flush).",
+        boundaries=LATENCY_BOUNDARIES)),
 }
 
 
@@ -417,3 +436,19 @@ def worker_tasks_executed() -> Counter:
 
 def timeline_events_dropped() -> Counter:
     return get("rmt_timeline_events_dropped_total")
+
+
+def logs_records() -> Counter:
+    return get("rmt_logs_records_total")
+
+
+def logs_bytes() -> Counter:
+    return get("rmt_logs_bytes_total")
+
+
+def logs_dropped() -> Counter:
+    return get("rmt_logs_dropped_total")
+
+
+def logs_flush_seconds() -> Histogram:
+    return get("rmt_logs_flush_seconds")

@@ -108,6 +108,13 @@ class NodeAgent:
         # adopt the cluster's fault-injection plane (same seed/spec the
         # head exported) so a chaos run is replayable across every host
         faults.configure_from(self.config)
+        # agent-process records (transfer serves, spill IO) join the log
+        # plane stamped with this node's identity; they ship to the head
+        # on the ping/pong piggyback like events and spans
+        from ..utils import structlog as _structlog
+
+        _structlog.configure(node_id=self.node_id.hex(), role="agent")
+        _structlog.install_logging_capture()
 
         _reap_stale_agent_stores()
         self.store_name = f"/rmtA_{os.getpid()}_{os.urandom(4).hex()}"
@@ -753,6 +760,7 @@ class NodeAgent:
                     pass
             elif t == "ping":
                 from ..utils import events as _events
+                from ..utils import structlog as _structlog
                 from ..utils import timeline as _timeline
 
                 evs = _events.drain_events(node_id=self.node_id.hex())
@@ -761,11 +769,14 @@ class NodeAgent:
                 # agent analog of the worker's profile piggyback; without
                 # it agent-side spans never reach the head's dump
                 prof = _timeline.drain_events_if_due(min_batch=1)
+                lgs = _structlog.drain_records()
                 pong: Dict[str, Any] = {"type": "pong"}
                 if evs:
                     pong["events"] = evs
                 if prof:
                     pong["profile"] = prof
+                if lgs:
+                    pong["logs"] = lgs
                 try:
                     self._send(pong)
                 except (OSError, BrokenPipeError):
@@ -773,6 +784,8 @@ class NodeAgent:
                         _events.ingest(evs)  # retry on next ping
                     if prof:
                         _timeline.ingest_events(prof)
+                    if lgs:
+                        _structlog.reingest(lgs)
                     return
             elif t == "shutdown":
                 return

@@ -437,6 +437,15 @@ class Runtime:
         # bound is on traces, matching task_history's retention spirit)
         self._traces: Dict[str, List[bytes]] = {}
         self._traces_cap = 2_000
+        # log plane: head-side store over every process's structured
+        # records (worker done replies + flush frames, agent pongs, and
+        # this process's own emits via the direct attach)
+        from ..utils import structlog as _structlog
+
+        self.log_store = _structlog.LogStore()
+        _structlog.configure(role="driver")
+        _structlog.install_logging_capture()
+        _structlog.attach_store(self.log_store)
         # hot-path instruments hoisted once (accessor calls touch the
         # registry lock)
         self._m_submitted = mdefs.tasks_submitted()
@@ -590,6 +599,11 @@ class Runtime:
                                    spec.get("labels"))
             if head or self._head_node_id is None:
                 self._head_node_id = node_id
+                # the driver process lives on the head node: stamp its
+                # own log records with that identity
+                from ..utils import structlog as _structlog
+
+                _structlog.configure(node_id=node_id.hex())
         self._wakeup()
         return node_id
 
@@ -830,10 +844,14 @@ class Runtime:
         elif mtype == "pong":
             # remote agents flush their structured-event buffer on the
             # keepalive reply (node_agent.py ping handler); timeline
-            # spans recorded agent-side (transfer serves, spill IO) ride
-            # the same reply so the head's dump covers every process
+            # spans recorded agent-side (transfer serves, spill IO) and
+            # the agent's structured log records ride the same reply so
+            # the head's dump covers every process
             events.ingest(msg.get("events") or [])
             timeline.ingest_events(msg.get("profile") or [])
+            from ..utils import structlog as _structlog
+
+            _structlog.ingest(msg.get("logs"))
 
     def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
         from .remote_node import VirtualConn
@@ -1137,13 +1155,17 @@ class Runtime:
             self._on_owned_put(handle, msg)
         elif mtype == "profile":
             # flush frame from a worker's ticker (or its final exit
-            # flush): straggler spans, plus optional piggybacked event
-            # and metric-series batches that merge into the head's
-            # buffers/registry (the agent->head aggregation path)
+            # flush): straggler spans, plus optional piggybacked event,
+            # log-record and metric-series batches that merge into the
+            # head's buffers/registry (the agent->head aggregation path)
             if msg.get("profile"):
                 timeline.ingest_events(msg["profile"])
             if msg.get("events"):
                 events.ingest(msg["events"])
+            if msg.get("logs"):
+                from ..utils import structlog as _structlog
+
+                _structlog.ingest(msg["logs"])
             if msg.get("series"):
                 from ..utils import metrics as _metrics
 
@@ -2016,11 +2038,20 @@ class Runtime:
         locations, dep-waiter resolution) — per-message locking was the
         completion side's dominant cost at high task rates."""
         profile: List[dict] = []
+        logs: List[dict] = []
         for m in msgs:
             if m.get("profile"):
                 profile.extend(m["profile"])
+            if m.get("logs"):
+                logs.extend(m["logs"])
         if profile:
             timeline.ingest_events(profile)
+        if logs:
+            # BEFORE futures resolve: a task's last log line must be
+            # queryable (state.get_logs) the moment its get() returns
+            from ..utils import structlog as _structlog
+
+            _structlog.ingest(logs)
         nm = self.nodes.get(handle.node_id)
         for m in msgs:
             # borrowed-ref tables ride every done reply (success or not)
@@ -3667,6 +3698,15 @@ class Runtime:
         self._stop.set()
         try:
             self.gcs.set_job_state(self.job_id.binary(), "FINISHED")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            # detach this cluster's LogStore so later emits in this
+            # process buffer for the NEXT cluster instead of landing in
+            # a dead store
+            from ..utils import structlog as _structlog
+
+            _structlog.attach_store(None)
         except Exception:  # noqa: BLE001
             pass
         try:

@@ -36,8 +36,10 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from .. import serialization as ser
-from ..utils import faults, tracing
+from ..utils import faults, structlog, tracing
 from .object_store import StoreClient
+
+log = structlog.get_logger(__name__)
 
 # Actor classes preloaded by the ZYGOTE before forking (zygote.serve):
 # every forked child inherits the loaded class via COW and skips its own
@@ -101,6 +103,20 @@ class _ReplySender:
         path, where os._exit follows immediately and a queued message
         would die with the process."""
         return self._write(msg)
+
+    def flush_queued(self) -> None:
+        """Synchronously deliver whatever the drain thread hasn't picked
+        up yet (exit path: a done reply enqueued microseconds before
+        shutdown must not lose the race with os._exit, and must reach
+        the head BEFORE the final log/profile flush frame). Popping
+        under _cond means each message is written exactly once whether
+        this or the drain thread claims it."""
+        with self._cond:
+            msgs = list(self._q)
+            self._q.clear()
+        if msgs:
+            self._write(msgs[0] if len(msgs) == 1 else
+                        {"type": "batch", "msgs": msgs})
 
     def _drain_loop(self) -> None:
         while True:
@@ -669,9 +685,8 @@ class Worker:
                     f"store full even after spilling; shipping a "
                     f"{data.total_size}-byte return inline",
                     severity=events.WARNING, source="core_worker")
-                print(f"[rmt] WARNING: node store full; return of "
-                      f"{data.total_size} bytes shipped inline",
-                      file=sys.stderr, flush=True)
+                log.warning("node store full; return of %s bytes "
+                            "shipped inline", data.total_size)
                 encoded.append((oid, "v", data.to_bytes()))
         return encoded
 
@@ -712,6 +727,9 @@ class Worker:
         # the current context when it attaches trace_parent)
         trace_ctx = tracing.from_wire(msg.get("trace_ctx"))
         trace_tok = tracing.set_current(trace_ctx)
+        # log records emitted by the task body (print, logging, package
+        # logger) attribute to this task via the same ContextVar pattern
+        log_tok = structlog.set_task_context(task_id.hex())
         try:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
@@ -745,6 +763,7 @@ class Worker:
             }
         finally:
             tracing.reset(trace_tok)
+            structlog.reset_task_context(log_tok)
             for oid in pinned:
                 self.store.release(oid)
         # drop the frame's refs BEFORE computing the borrow table: only
@@ -755,6 +774,12 @@ class Worker:
         reply["profile"] = self._profile_batch(
             f"task::{msg.get('name', 'task')}", t0,
             trace=trace_ctx, task_id=task_id)
+        # the task's buffered log records ride ITS done reply: the head
+        # ingests them before resolving the completion future, so a
+        # task's last line is queryable the moment get() returns
+        lgs = structlog.drain_records()
+        if lgs:
+            reply["logs"] = lgs
         # worker-side lifecycle stamps ride the reply; the owner merges
         # them into the task's transition record (task_events analog)
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
@@ -884,6 +909,8 @@ class Worker:
         t0 = time.time()
         trace_ctx = tracing.from_wire(msg.get("trace_ctx"))
         trace_tok = tracing.set_current(trace_ctx)
+        log_tok = structlog.set_task_context(task_id.hex(),
+                                            msg["actor_id"].hex())
         try:
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             if inspect.iscoroutinefunction(method):
@@ -896,17 +923,21 @@ class Worker:
                 loop = state.ensure_loop()
 
                 async def _bounded(m=method, a=args, kw=kwargs, s=state,
-                                   tc=trace_ctx):
+                                   tc=trace_ctx, tid=task_id,
+                                   aid=msg["actor_id"]):
                     # run_coroutine_threadsafe does NOT inherit this
                     # dispatcher thread's contextvars — the trace context
-                    # must be installed INSIDE the coroutine for nested
-                    # submits awaited by the method body to chain
+                    # (and the log plane's task context) must be installed
+                    # INSIDE the coroutine for nested submits awaited by
+                    # the method body to chain
                     tok = tracing.set_current(tc)
+                    ltok = structlog.set_task_context(tid.hex(), aid.hex())
                     try:
                         async with s.async_sem:
                             return await m(*a, **kw)
                     finally:
                         tracing.reset(tok)
+                        structlog.reset_task_context(ltok)
 
                 fut = asyncio.run_coroutine_threadsafe(_bounded(), loop)
                 fut.add_done_callback(
@@ -926,6 +957,7 @@ class Worker:
                      "error": self._encode_error(msg["method"], e)}
         finally:
             tracing.reset(trace_tok)
+            structlog.reset_task_context(log_tok)
         for oid in pinned:
             self.store.release(oid)
         # only refs retained in actor/user state survive this drop and
@@ -934,6 +966,9 @@ class Worker:
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0,
             trace=trace_ctx, task_id=task_id)
+        lgs = structlog.drain_records()
+        if lgs:
+            reply["logs"] = lgs
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
@@ -971,6 +1006,9 @@ class Worker:
         reply["profile"] = self._profile_batch(
             f"actor::{msg.get('name', msg['method'])}", t0,
             trace=tracing.from_wire(msg.get("trace_ctx")), task_id=task_id)
+        lgs = structlog.drain_records()
+        if lgs:
+            reply["logs"] = lgs
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
@@ -1011,21 +1049,25 @@ class Worker:
     # -- main loop ------------------------------------------------------------
     def _flush_frame(self, spans: List[dict]) -> Optional[dict]:
         """Build one combined flush frame: straggler timeline spans plus
-        this process's buffered events and metric-series deltas (the
-        agent→head aggregation ride-along). None when nothing moved."""
+        this process's buffered events, log records and metric-series
+        deltas (the agent→head aggregation ride-along). None when
+        nothing moved."""
         from ..utils import events as _events
         from ..utils import metrics as _metrics
 
         evs = _events.drain_events()
+        lgs = structlog.drain_records()
         try:
             series = _metrics.snapshot_deltas()
         except Exception:  # noqa: BLE001 — never block the flush on stats
             series = []
-        if not (spans or evs or series):
+        if not (spans or evs or lgs or series):
             return None
         frame: dict = {"type": "profile", "profile": spans or []}
         if evs:
             frame["events"] = evs
+        if lgs:
+            frame["logs"] = lgs
         if series:
             frame["series"] = series
         return frame
@@ -1053,6 +1095,13 @@ class Worker:
         never be scheduled again). Failures are moot: if the pipe is
         already closed the head has moved on."""
         try:
+            # queued done replies first: their attached log batches must
+            # land before (and never lose the os._exit race to) the
+            # trailing flush frame
+            self.sender.flush_queued()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            pass
+        try:
             from ..utils import timeline
 
             spans = timeline.drain_events_if_due(min_batch=1, max_age_s=0.0)
@@ -1068,6 +1117,11 @@ class Worker:
         _worker_context.set_proxy(self.proxy)
         if os.environ.get("RMT_LOG_TO_DRIVER") == "1":
             self.start_output_capture()
+        # structured capture layers OVER the raw fd capture: the tee
+        # writes through to the pipe (driver live tail unchanged) while
+        # minting attributed records for the head LogStore
+        structlog.configure(node_id=self.node_id.hex(), role="worker")
+        structlog.install_worker_capture()
         threading.Thread(target=self._profile_flush_loop, daemon=True,
                          name="profile-flush").start()
         # registration doubles as the ready signal (exec-then-connect

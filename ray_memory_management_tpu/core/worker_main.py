@@ -43,6 +43,13 @@ def main() -> None:
             time.sleep(0.1 * (attempt + 1))
     if conn is None:
         return
+    # identity first, so records emitted during the remaining imports
+    # (or a bootstrap actor's __init__) already carry node/role stamps;
+    # the RMT_LOGS gate itself is read at structlog import from the
+    # inherited environment, same contract as RMT_TIMELINE
+    from ..utils import structlog
+
+    structlog.configure(node_id=node_id.hex(), role="worker")
     from .worker import Worker
 
     w = Worker(conn, worker_id, node_id, store_name, inline_limit)

@@ -18,6 +18,9 @@ Routes::
     /api/timeline           Chrome traceEvents JSON (load in Perfetto);
                             filters: ?task_id=&trace_id=&cat=&limit=
     /api/trace?trace_id=    span tree + critical-path attribution
+    /api/logs               structured log records + dropped count;
+                            filters: ?task_id=&trace_id=&node_id=
+                            &level=&since=&limit= (400 on bad params)
     /metrics                Prometheus exposition text
 """
 
@@ -166,6 +169,43 @@ class Dashboard:
             data = {
                 "trace": state.get_trace(trace_id),
                 "critical_path": state.summarize_critical_path(trace_id),
+            }
+        elif path == "/api/logs":
+            from .utils import structlog as _structlog
+
+            limit = 1000
+            if "limit" in query:
+                try:
+                    limit = int(query["limit"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "limit must be an integer"}')
+                if limit < 0:
+                    return (400, "application/json",
+                            b'{"error": "limit must be >= 0"}')
+            since = None
+            if "since" in query:
+                try:
+                    since = float(query["since"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "since must be a timestamp"}')
+            level = query.get("level")
+            if level is not None and \
+                    level.upper() not in _structlog.LEVELS:
+                return (400, "application/json",
+                        json.dumps({"error": "level must be one of "
+                                    + "/".join(_structlog.LEVELS)}).encode())
+            data = {
+                "logs": state.get_logs(
+                    task_id=query.get("task_id"),
+                    trace_id=query.get("trace_id"),
+                    node_id=query.get("node_id"),
+                    level=level, since=since, limit=limit),
+                # drops since start (worker buffer overflow seen locally
+                # + store retention evictions): non-zero warns the view
+                # is a suffix — mirrors /api/timeline
+                "dropped": _structlog.dropped_count(),
             }
         else:
             return 404, "application/json", b'{"error": "not found"}'

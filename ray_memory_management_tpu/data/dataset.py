@@ -191,6 +191,7 @@ class Dataset:
 
     def show(self, limit: int = 20) -> None:
         for row in self.take(limit):
+            # rmtcheck: disable=log-discipline — show() IS console output
             print(row)
 
     def limit(self, limit: int) -> "Dataset":

@@ -168,6 +168,49 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Structured log records of the runtime in THIS process (like
+    ``trace``/``summary``, reads the in-process runtime — call
+    main(['logs', ...]) from a driver). ``--follow`` poll-tails the
+    head store, printing new records as workers ship them — the
+    driver-live-tail analog of Ray's worker-output streaming."""
+    import time
+
+    from ray_memory_management_tpu import _worker_context, state
+    from ray_memory_management_tpu.utils import structlog
+
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['logs']))",
+              file=sys.stderr)
+        return 1
+
+    def fetch(since_seq: int):
+        recs = state.get_logs(task_id=args.task_id,
+                              trace_id=args.trace_id,
+                              node_id=args.node_id,
+                              level=args.level,
+                              limit=args.limit)
+        return [r for r in recs if r.get("seq", 0) > since_seq]
+
+    last_seq = 0
+    deadline = (time.monotonic() + args.duration
+                if args.duration is not None else None)
+    try:
+        while True:
+            for rec in fetch(last_seq):
+                print(structlog.format_record(rec))
+                last_seq = max(last_seq, rec.get("seq", 0))
+            if not args.follow:
+                return 0
+            if deadline is not None and time.monotonic() >= deadline:
+                return 0
+            time.sleep(args.poll_interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_microbenchmark(args) -> int:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -340,6 +383,29 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--output", default=None,
                    help="write JSON here instead of stdout")
     s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
+        "logs",
+        help="query the cluster log plane (print/logging output of every "
+             "worker, trace-correlated); --follow live-tails it")
+    s.add_argument("--task", dest="task_id", default=None,
+                   help="filter: task id (hex)")
+    s.add_argument("--trace", dest="trace_id", default=None,
+                   help="filter: trace id (hex)")
+    s.add_argument("--node", dest="node_id", default=None,
+                   help="filter: node id (hex)")
+    s.add_argument("--level", default=None,
+                   help="minimum severity (DEBUG/INFO/WARNING/ERROR/"
+                        "CRITICAL)")
+    s.add_argument("--limit", type=int, default=1000,
+                   help="newest N records per poll (default 1000)")
+    s.add_argument("--follow", action="store_true",
+                   help="poll for new records until interrupted")
+    s.add_argument("--duration", type=float, default=None,
+                   help="with --follow: stop after this many seconds")
+    s.add_argument("--poll-interval", type=float, default=0.5,
+                   help="follow poll period in seconds (default 0.5)")
+    s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("microbenchmark",
                        help="run the core microbenchmark suite")

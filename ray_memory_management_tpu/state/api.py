@@ -318,6 +318,29 @@ def get_trace(trace_id: str) -> Dict[str, Any]:
             "roots": roots, "spans": spans}
 
 
+def get_logs(task_id: Optional[str] = None,
+             trace_id: Optional[str] = None,
+             node_id: Optional[str] = None,
+             level: Optional[str] = None,
+             since: Optional[float] = None,
+             limit: int = 1000) -> List[Dict[str, Any]]:
+    """Query the cluster's structured log plane (utils/structlog.py):
+    every record a worker/agent/driver process captured — package-logger
+    lines, user ``logging`` calls, and teed task ``print()`` output —
+    stamped with node/pid/role/task/actor/trace/span identity. Filters
+    are ANDed; ``level`` is a MINIMUM severity (``"WARNING"`` returns
+    WARNING and above), ``since`` an exclusive ts lower bound; the
+    newest ``limit`` records return oldest-first. Id filters take hex
+    strings (the ids list_tasks/get_trace rows carry)."""
+    rt = _runtime()
+    store = getattr(rt, "log_store", None)
+    if store is None:
+        return []
+    return store.query(task_id=task_id, trace_id=trace_id,
+                       node_id=node_id, level=level, since=since,
+                       limit=limit)
+
+
 # Critical-path attribution: stage -> transition-stamp intervals, listed
 # in PRIORITY order. A wall-clock instant covered by several overlapping
 # intervals (a sibling executing while another waits in queue) is charged

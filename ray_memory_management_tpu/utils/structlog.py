@@ -1,0 +1,592 @@
+"""Cluster log plane: trace-correlated structured records.
+
+The third observability pillar next to the metric registry
+(core/metrics_defs.py) and the trace/timeline plane (utils/tracing.py,
+utils/timeline.py). Every record is a plain JSON-able dict stamped with
+``(node_id, pid, role, task_id, actor_id, trace_id, span_id, level,
+ts)`` — the trace fields are pulled automatically from the tracing
+ContextVar at emit time, the task/actor fields from a second ContextVar
+the worker installs around task execution, so a ``print()`` deep inside
+user code lands in the store already correlated with its span.
+
+Three capture sources feed one process-local pipeline:
+
+- the package logger (``get_logger(__name__)``) — library code's
+  replacement for bare ``print()``/ad-hoc ``logging``;
+- a ``logging.Handler`` bridge, attached to the root logger in worker
+  processes so user tasks' stdlib ``logging`` calls are captured;
+- stdout/stderr tee streams layered over the fd-level pipe capture
+  (worker.start_output_capture), so user-task ``print()`` yields a
+  structured record AND still reaches the driver's raw live tail.
+
+Transport reuses the existing planes: worker records ride done replies
+and profile flush frames (including ``_final_flush`` on exit, so a
+task's last line survives ``os._exit``); agent-process records piggyback
+on ping/pong like events and spans. The process buffer is bounded —
+under backpressure the oldest records drop with
+``rmt_logs_dropped_total{reason="buffer_full"}`` accounting, mirroring
+the timeline ring's drop discipline.
+
+Head side, ``LogStore`` keeps per-level rings (per-level retention: a
+DEBUG flood cannot evict the ERROR history) with indices by task, trace
+and node for the ``state.get_logs`` / ``/api/logs`` / ``rmt logs``
+query surfaces. ERROR-and-above records are additionally synthesized
+into timeline instant events so Perfetto shows log markers on the span
+track. The whole plane is gated by ``RMT_LOGS=0`` (same contract as
+``RMT_TIMELINE``), which is what utils/logging_bench.py measures.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import io
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from . import tracing
+
+# -- levels -------------------------------------------------------------------
+
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+CRITICAL = "CRITICAL"
+
+LEVELS: Tuple[str, ...] = (DEBUG, INFO, WARNING, ERROR, CRITICAL)
+_LEVELNO: Dict[str, int] = {lvl: (i + 1) * 10 for i, lvl in enumerate(LEVELS)}
+
+
+def level_no(level: str) -> int:
+    return _LEVELNO.get(level, _LEVELNO[INFO])
+
+
+def _normalize_level(level: Optional[str]) -> str:
+    if isinstance(level, str):
+        up = level.upper()
+        if up in _LEVELNO:
+            return up
+        if up == "WARN":
+            return WARNING
+        if up == "FATAL":
+            return CRITICAL
+    return INFO
+
+
+# -- enable gate (RMT_LOGS, mirroring RMT_TIMELINE) ---------------------------
+
+_enabled = os.environ.get("RMT_LOGS", "1") != "0"
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+# -- process identity + task context ------------------------------------------
+
+_node_id: Optional[str] = None
+_role: str = "driver"
+
+# (task_id_hex, actor_id_hex) — installed by the worker around task
+# execution (and re-installed INSIDE async actor coroutines, which do
+# not inherit the dispatcher thread's contextvars)
+_task_ctx: contextvars.ContextVar[Optional[Tuple[str, Optional[str]]]] = \
+    contextvars.ContextVar("rmt_log_task_ctx", default=None)
+
+
+def configure(node_id: Optional[str] = None, role: Optional[str] = None
+              ) -> None:
+    """Stamp this process's identity onto every subsequent record."""
+    global _node_id, _role
+    if node_id is not None:
+        _node_id = node_id
+    if role is not None:
+        _role = role
+
+
+def set_task_context(task_id: Optional[str],
+                     actor_id: Optional[str] = None):
+    """Install the executing task's identity; returns the reset token."""
+    return _task_ctx.set((task_id, actor_id) if task_id else None)
+
+
+def reset_task_context(token) -> None:
+    try:
+        _task_ctx.reset(token)
+    except Exception:  # noqa: BLE001 — token from another context
+        _task_ctx.set(None)
+
+
+# -- record construction + process-local buffer -------------------------------
+
+# bounded: a chatty task must not balloon worker memory between flushes;
+# overflow drops OLDEST (the tail of a crash log is worth more than its
+# head) with reason-tagged accounting
+MAX_BUFFER = 10_000
+
+_lock = threading.Lock()
+_buffer: deque = deque()  # guarded-by: _lock
+_store: Optional["LogStore"] = None  # head-side direct attach
+_buf_dropped = 0  # guarded-by: _lock
+
+_m_records = None
+_m_bytes = None
+_m_dropped = None
+
+
+def _instruments():
+    global _m_records, _m_bytes, _m_dropped
+    if _m_records is None:
+        from ..core import metrics_defs as mdefs
+
+        _m_records = mdefs.logs_records()
+        _m_bytes = mdefs.logs_bytes()
+        _m_dropped = mdefs.logs_dropped()
+    return _m_records, _m_bytes, _m_dropped
+
+
+def make_record(level: str, msg: str, logger: str = "rmt",
+                stream: str = "logging") -> dict:
+    """Build one structured record, stamping identity, task/actor and
+    trace context at EMIT time (attribution must not wait for the flush,
+    by which point the ContextVar is long gone)."""
+    tctx = _task_ctx.get()
+    trace = tracing.get_current()
+    return {
+        "ts": time.time(),
+        "level": _normalize_level(level),
+        "msg": msg,
+        "logger": logger,
+        "stream": stream,
+        "node_id": _node_id,
+        "pid": os.getpid(),
+        "role": _role,
+        "task_id": tctx[0] if tctx else None,
+        "actor_id": tctx[1] if tctx else None,
+        "trace_id": trace[0] if trace else None,
+        "span_id": trace[1] if trace else None,
+    }
+
+
+def emit_record(rec: dict) -> None:
+    """Route one record: straight into the attached head store, or into
+    the bounded process buffer awaiting the next flush frame."""
+    if not _enabled:
+        return
+    try:
+        m_rec, m_bytes, m_drop = _instruments()
+        m_rec.inc(tags={"stream": rec.get("stream") or "logging"})
+        m_bytes.inc(len(rec.get("msg") or ""))
+    except Exception:  # noqa: BLE001 — stats must never block a log line
+        m_drop = None
+    store = _store
+    if store is not None:
+        store.add(rec)
+        return
+    with _lock:
+        if len(_buffer) >= MAX_BUFFER:
+            _buffer.popleft()
+            global _buf_dropped
+            _buf_dropped += 1
+            if m_drop is not None:
+                try:
+                    m_drop.inc(tags={"reason": "buffer_full"})
+                except Exception:  # noqa: BLE001
+                    pass
+        _buffer.append(rec)
+
+
+def emit(level: str, msg: str, logger: str = "rmt",
+         stream: str = "logging") -> None:
+    if not _enabled:
+        return
+    emit_record(make_record(level, msg, logger=logger, stream=stream))
+
+
+def drain_records() -> List[dict]:
+    """Drain the process buffer for a flush frame (worker ticker, done
+    reply, final flush, agent pong). Observes ``rmt_logs_flush_seconds``
+    so the golden exposition test sees the batch path exercised."""
+    with _lock:
+        if not _buffer:
+            return []
+        t0 = time.perf_counter()
+        out = list(_buffer)
+        _buffer.clear()
+    try:
+        from ..core import metrics_defs as mdefs
+
+        mdefs.logs_flush_seconds().observe(time.perf_counter() - t0)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def reingest(records: Iterable[dict]) -> None:
+    """Put drained records back at the FRONT of the buffer (a pong send
+    failed; they retry on the next tick, oldest still dropping first)."""
+    with _lock:
+        _buffer.extendleft(reversed(list(records)))
+        global _buf_dropped
+        while len(_buffer) > MAX_BUFFER:
+            _buffer.popleft()
+            _buf_dropped += 1
+
+
+def ingest(records: Optional[Iterable[dict]]) -> None:
+    """Head-side ingest of records that arrived on a wire frame."""
+    if not records:
+        return
+    store = _store
+    if store is not None:
+        for rec in records:
+            if isinstance(rec, dict):
+                store.add(rec)
+        return
+    with _lock:
+        _buffer.extend(r for r in records if isinstance(r, dict))
+        global _buf_dropped
+        while len(_buffer) > MAX_BUFFER:
+            _buffer.popleft()
+            _buf_dropped += 1
+
+
+def attach_store(store: Optional["LogStore"]) -> None:
+    """Bind the head process's LogStore: local emits and wire ingests go
+    straight in (immediately queryable). Pass None to detach."""
+    global _store
+    _store = store
+    if store is not None:
+        with _lock:
+            backlog = list(_buffer)
+            _buffer.clear()
+        for rec in backlog:
+            store.add(rec)
+
+
+def dropped_count() -> int:
+    """Drops visible from this process: local buffer overflow plus (when
+    the head store is attached) its retention evictions — the number
+    ``/api/logs`` reports next to results, mirroring ``/api/timeline``."""
+    with _lock:
+        n = _buf_dropped
+    store = _store
+    if store is not None:
+        n += store.dropped_count()
+    return n
+
+
+def clear() -> None:
+    """Test hook: reset buffer, drop counters and store attachment."""
+    global _buf_dropped, _store
+    with _lock:
+        _buffer.clear()
+        _buf_dropped = 0
+    _store = None
+
+
+# -- package logger + stdlib logging bridge -----------------------------------
+
+_PKG_PREFIX = "ray_memory_management_tpu"
+
+
+class _StructHandler(logging.Handler):
+    """Bridges stdlib ``logging`` records into the structured pipeline
+    (level and logger name preserved; message rendered once, here)."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            emit(record.levelname, record.getMessage(),
+                 logger=record.name, stream="logging")
+        except Exception:  # noqa: BLE001 — a log call must never raise
+            pass
+
+
+_handler_installed_on: set = set()
+
+
+def get_logger(name: str) -> logging.Logger:
+    """The package logger library code adopts in place of bare print().
+
+    ``get_logger(__name__)`` maps ``ray_memory_management_tpu.core.X``
+    to the ``rmt.core.X`` namespace, all children of one ``rmt`` root
+    that carries the structured bridge. Propagation to the stdlib root
+    stays on, so an application's own logging config still sees these
+    records.
+    """
+    short = name
+    if short.startswith(_PKG_PREFIX):
+        short = short[len(_PKG_PREFIX):].lstrip(".")
+    log = logging.getLogger(f"rmt.{short}" if short else "rmt")
+    _install_handler(logging.getLogger("rmt"))
+    return log
+
+
+def _install_handler(target: logging.Logger) -> None:
+    key = target.name or "<root>"
+    if key in _handler_installed_on:
+        return
+    if not any(isinstance(h, _StructHandler) for h in target.handlers):
+        target.addHandler(_StructHandler())
+    if target.level == logging.NOTSET and target.name:
+        target.setLevel(logging.INFO)
+    _handler_installed_on.add(key)
+
+
+def install_logging_capture(root: bool = False) -> None:
+    """Attach the structured bridge. With ``root=True`` (worker
+    processes) the handler sits on the stdlib ROOT logger so user tasks'
+    own ``logging`` calls are captured too — in that case the ``rmt``
+    hierarchy reaches it by propagation, so the ``rmt`` logger itself
+    must NOT also carry a handler (double capture)."""
+    if root:
+        rootlog = logging.getLogger()
+        if not any(isinstance(h, _StructHandler) for h in rootlog.handlers):
+            rootlog.addHandler(_StructHandler())
+        if rootlog.level in (logging.NOTSET, logging.WARNING):
+            # worker processes are ours: open the gate to INFO so task
+            # logging.info() is captured (stdlib default is WARNING)
+            rootlog.setLevel(logging.INFO)
+        _handler_installed_on.add("<root>")
+        # drop the rmt-level handler if one was installed earlier in
+        # this process — propagation now covers it
+        rmtlog = logging.getLogger("rmt")
+        for h in list(rmtlog.handlers):
+            if isinstance(h, _StructHandler):
+                rmtlog.removeHandler(h)
+        _handler_installed_on.discard("rmt")
+    else:
+        _install_handler(logging.getLogger("rmt"))
+
+
+# -- stdout/stderr tee --------------------------------------------------------
+
+class _TeeStream(io.TextIOBase):
+    """Write-through wrapper over the fd-backed stream installed by
+    start_output_capture: text still reaches the raw fd pipe (driver
+    live tail, unchanged), and each completed LINE becomes a structured
+    record with full task/trace attribution. Partial writes accumulate —
+    ``print("x")`` issues two writes ("x", "\\n") and must yield ONE
+    record."""
+
+    def __init__(self, inner, level: str, stream: str):
+        self._inner = inner
+        self._level = level
+        self._stream = stream
+        self._pending = ""
+
+    def write(self, s: str) -> int:
+        n = self._inner.write(s)
+        if _enabled and s:
+            self._pending += s
+            if "\n" in self._pending:
+                *lines, self._pending = self._pending.split("\n")
+                for line in lines:
+                    if line.strip():
+                        emit(self._level, line, logger=self._stream,
+                             stream=self._stream)
+        return n
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def writable(self) -> bool:
+        return True
+
+    @property
+    def encoding(self):
+        return getattr(self._inner, "encoding", "utf-8")
+
+    def fileno(self) -> int:
+        return self._inner.fileno()
+
+    def isatty(self) -> bool:
+        return False
+
+
+def install_worker_capture() -> None:
+    """Worker-process capture: tee sys.stdout/sys.stderr (layered over
+    whatever is installed — the fd-pipe streams when log_to_driver is
+    on) and bridge the stdlib root logger. Called once from
+    Worker.run()."""
+    import sys
+
+    if not _enabled:
+        return
+    if not isinstance(sys.stdout, _TeeStream):
+        sys.stdout = _TeeStream(sys.stdout, INFO, "stdout")
+    if not isinstance(sys.stderr, _TeeStream):
+        sys.stderr = _TeeStream(sys.stderr, WARNING, "stderr")
+    install_logging_capture(root=True)
+
+
+# -- head-side store ----------------------------------------------------------
+
+# per-level retention: one ring per severity so a DEBUG/INFO flood
+# cannot evict the ERROR history (the records worth keeping longest)
+DEFAULT_RETENTION: Dict[str, int] = {
+    DEBUG: 20_000,
+    INFO: 50_000,
+    WARNING: 20_000,
+    ERROR: 20_000,
+    CRITICAL: 5_000,
+}
+
+_INDEX_KEY_CAP = 50_000  # distinct task/trace/node keys before eviction
+
+
+class LogStore:
+    """Head-side ring buffer over the cluster's structured records.
+
+    Per-level deques give per-level retention; secondary indices by
+    task, trace and node make the common queries ("everything this
+    trace logged, cluster-wide") O(result) instead of O(ring). Index
+    entries are pruned lazily: a record is live iff its monotone ``seq``
+    is still inside its level ring, so eviction costs nothing at add
+    time and drops fall out naturally at query time.
+    """
+
+    def __init__(self, retention: Optional[Dict[str, int]] = None):
+        ret = dict(DEFAULT_RETENTION)
+        if retention:
+            for lvl, cap in retention.items():
+                ret[_normalize_level(lvl)] = int(cap)
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {
+            lvl: deque(maxlen=cap) for lvl, cap in ret.items()
+        }  # guarded-by: _lock
+        self._by_task: Dict[str, deque] = {}  # guarded-by: _lock
+        self._by_trace: Dict[str, deque] = {}  # guarded-by: _lock
+        self._by_node: Dict[str, deque] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+
+    # -- write ----------------------------------------------------------------
+    def add(self, rec: dict) -> None:
+        level = _normalize_level(rec.get("level"))
+        rec["level"] = level
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            ring = self._rings[level]
+            if ring.maxlen and len(ring) == ring.maxlen:
+                self._dropped += 1
+                try:
+                    _instruments()[2].inc(tags={"reason": "retention"})
+                except Exception:  # noqa: BLE001
+                    pass
+            ring.append(rec)
+            for index, key in ((self._by_task, rec.get("task_id")),
+                               (self._by_trace, rec.get("trace_id")),
+                               (self._by_node, rec.get("node_id"))):
+                if key:
+                    bucket = index.get(key)
+                    if bucket is None:
+                        if len(index) >= _INDEX_KEY_CAP:
+                            index.pop(next(iter(index)))
+                        bucket = index[key] = deque()
+                    bucket.append(rec)
+        if level_no(level) >= _LEVELNO[ERROR]:
+            self._mark_timeline(rec)
+
+    @staticmethod
+    def _mark_timeline(rec: dict) -> None:
+        """ERROR+ records double as timeline instant events — log
+        markers on the Perfetto span track, joined to the trace's flow
+        group via the record's own trace context."""
+        try:
+            from . import timeline
+
+            if not timeline.is_enabled():
+                return
+            trace = None
+            if rec.get("trace_id") and rec.get("span_id"):
+                trace = (rec["trace_id"], rec["span_id"], None)
+            node = rec.get("node_id")
+            extra = {"message": (rec.get("msg") or "")[:200],
+                     "level": rec["level"]}
+            if rec.get("task_id"):
+                extra["task_id"] = rec["task_id"]
+            timeline.record_event(
+                f"log::{rec['level']}", "log", rec.get("ts", 0.0),
+                rec.get("ts", 0.0),
+                pid=f"node:{node[:8]}" if node else "driver",
+                extra=extra, trace=trace, instant=True)
+        except Exception:  # noqa: BLE001 — marker synthesis is advisory
+            pass
+
+    # -- read -----------------------------------------------------------------
+    def _min_live_seq(self) -> Dict[str, int]:
+        return {lvl: (ring[0]["seq"] if ring else self._seq + 1)
+                for lvl, ring in self._rings.items()}
+
+    def query(self, task_id: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              node_id: Optional[str] = None,
+              level: Optional[str] = None,
+              since: Optional[float] = None,
+              limit: Optional[int] = 1000) -> List[dict]:
+        """Filtered view, oldest-first, newest-``limit``. ``level`` is a
+        MINIMUM severity (``level="WARNING"`` returns WARNING+ERROR+
+        CRITICAL); ``since`` is an exclusive ts lower bound."""
+        min_no = level_no(_normalize_level(level)) if level else 0
+        with self._lock:
+            floors = self._min_live_seq()
+            if task_id:
+                cands = self._narrow(self._by_task, task_id, floors)
+            elif trace_id:
+                cands = self._narrow(self._by_trace, trace_id, floors)
+            elif node_id:
+                cands = self._narrow(self._by_node, node_id, floors)
+            else:
+                cands = [r for ring in self._rings.values() for r in ring]
+            out = [
+                r for r in cands
+                if (not task_id or r.get("task_id") == task_id)
+                and (not trace_id or r.get("trace_id") == trace_id)
+                and (not node_id or r.get("node_id") == node_id)
+                and (not min_no or level_no(r["level"]) >= min_no)
+                and (since is None or r.get("ts", 0.0) > since)
+            ]
+        out.sort(key=lambda r: r["seq"])
+        if limit is not None and limit >= 0:
+            # the [-0:] gotcha: limit=0 means "no records", not "all"
+            out = out[-limit:] if limit else []
+        return out
+
+    def _narrow(self, index: Dict[str, deque], key: str,
+                floors: Dict[str, int]) -> List[dict]:  # rmtcheck: holds=_lock
+        bucket = index.get(key)
+        if not bucket:
+            return []
+        # lazy prune: entries evicted from their level ring are dead
+        while bucket and bucket[0]["seq"] < floors[bucket[0]["level"]]:
+            bucket.popleft()
+        if not bucket:
+            del index[key]
+            return []
+        return list(bucket)
+
+    def dropped_count(self) -> int:
+        with self._lock:
+            return self._dropped
+
+
+def format_record(rec: dict) -> str:
+    """One human line per record — the ``rmt logs`` CLI rendering."""
+    ts = time.strftime("%H:%M:%S", time.localtime(rec.get("ts", 0.0)))
+    node = (rec.get("node_id") or "-")[:8]
+    task = (rec.get("task_id") or "-")[:8]
+    trace = (rec.get("trace_id") or "-")[:8]
+    return (f"{ts} {rec.get('level', INFO):<8} "
+            f"(node={node} task={task} trace={trace}) "
+            f"[{rec.get('logger', 'rmt')}] {rec.get('msg', '')}")

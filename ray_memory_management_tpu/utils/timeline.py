@@ -62,12 +62,15 @@ def dropped_count() -> int:
 def record_event(name: str, cat: str, start: float, end: float,
                  pid: Any = None, tid: Any = None,
                  extra: Optional[dict] = None,
-                 trace=None) -> None:
+                 trace=None, instant: bool = False) -> None:
     """Record one complete ("ph":"X") span. Timestamps are time.time()
     seconds; converted to microseconds at dump time. ``trace`` is an
     optional (trace_id, span_id, parent_span_id) context — its ids land
     in the span's args, which is what the flow-event synthesis in
-    chrome_trace_events and the /api/timeline filters key on."""
+    chrome_trace_events and the /api/timeline filters key on.
+    ``instant=True`` marks a zero-duration moment rendered as a Chrome
+    instant event ("ph":"i") — how ERROR-level log records show up as
+    markers on the span track."""
     if not _enabled:
         return
     ev = {
@@ -78,6 +81,8 @@ def record_event(name: str, cat: str, start: float, end: float,
         "pid": pid if pid is not None else f"pid:{os.getpid()}",
         "tid": tid if tid is not None else threading.get_ident(),
     }
+    if instant:
+        ev["instant"] = True
     if trace:
         from . import tracing
 
@@ -268,6 +273,12 @@ def chrome_trace_events(task_id: Optional[str] = None,
             "pid": ev.get("pid", 0),
             "tid": ev.get("tid", 0),
         }
+        if ev.get("instant"):
+            # zero-duration marker (log-plane ERROR records): thread-
+            # scoped instant, no dur
+            entry["ph"] = "i"
+            entry["s"] = "t"
+            del entry["dur"]
         if args:
             entry["args"] = args
         out.append(entry)

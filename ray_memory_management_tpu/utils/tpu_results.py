@@ -64,10 +64,9 @@ def record(fn_name: str, kwargs: dict | None, result: dict) -> None:
     hand-run sweep and a bench.py row subprocess can race; without the
     lock one of the two measurements silently vanishes). Failures to
     persist are swallowed — recording must never break the measurement
-    that produced the data — but LOUDLY, on stderr.
+    that produced the data — but LOUDLY, via the package logger.
     """
     import fcntl
-    import sys
 
     try:
         path = results_path()
@@ -93,8 +92,10 @@ def record(fn_name: str, kwargs: dict | None, result: dict) -> None:
                     pass
                 raise
     except Exception as e:
-        print(f"tpu_results: could not persist {fn_name} row: {e!r}",
-              file=sys.stderr)
+        from . import structlog
+
+        structlog.get_logger(__name__).warning(
+            "could not persist %s row: %r", fn_name, e)
 
 
 def freshest(fn_name: str, kwargs: dict | None = None):

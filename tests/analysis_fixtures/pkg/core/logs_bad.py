@@ -1,0 +1,25 @@
+"""Fixture: log-discipline seeds (bare print, eager-format log call)."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def noisy():
+    print("anonymous line")  # SEEDED: log-discipline
+
+
+def eager(v):
+    log.warning(f"eager {v}")  # SEEDED: log-discipline
+
+
+def lazy_ok(v):
+    log.warning("lazy %s", v)
+
+
+def suppressed_print():
+    print("audited")  # rmtcheck: disable=log-discipline — fixture twin
+
+
+def suppressed_eager(v):
+    log.error(f"audited {v}")  # rmtcheck: disable=log-discipline — twin

@@ -235,6 +235,32 @@ def test_headline_line_carries_tracing_summary(bench):
         assert line["tracing"]["overhead_pct"] == 2.4
 
 
+def test_logging_suite_reports_required_fields(bench):
+    """The logging suite must emit every field the BENCH_DETAIL.json
+    contract names (on/off tasks-per-s, overhead pct) — run a mini-sized
+    pass so CI proves the real code path, not a fixture."""
+    from ray_memory_management_tpu.utils.logging_bench import (
+        run_logging_suite,
+    )
+
+    out = run_logging_suite(n_tasks=16, trials=1)
+    missing = [k for k in bench.REQUIRED_LOGGING_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["logging_on_tasks_per_s"] > 0
+    assert out["logging_off_tasks_per_s"] > 0
+
+
+def test_headline_line_carries_logging_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    logging_out = {"logging_overhead_pct": 1.8}
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, logging=logging_out)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "logging" in line:  # may be popped only by the <1KB guard
+        assert line["logging"]["overhead_pct"] == 1.8
+
+
 def test_elastic_suite_reports_required_fields(bench):
     """The elastic-training suite must emit every field the
     BENCH_DETAIL.json contract names (steps/s off/sync/async, blocking
@@ -373,6 +399,23 @@ def test_bench_detail_snapshot_has_tracing_section(bench):
     if "error" not in tracing:
         missing = [k for k in bench.REQUIRED_TRACING_FIELDS
                    if k not in tracing]
+        assert not missing, missing
+
+
+def test_bench_detail_snapshot_has_logging_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the logging section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    logging_out = detail.get("logging")
+    if logging_out is None:
+        pytest.skip("snapshot predates the logging section")
+    if "error" not in logging_out:
+        missing = [k for k in bench.REQUIRED_LOGGING_FIELDS
+                   if k not in logging_out]
         assert not missing, missing
 
 

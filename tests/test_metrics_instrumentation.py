@@ -139,6 +139,38 @@ class TestPrometheusExposition:
         assert ('rmt_collective_quantized_ops_total'
                 '{op="allreduce",precision="int8"}') in text
 
+    def test_logging_series_in_exposition(self):
+        """Golden coverage for the log-plane series: the record/byte
+        counters (per stream), the drop counter (per reason), and the
+        flush-latency histogram must all surface in the exposition once
+        they have moved."""
+        counters = ("rmt_logs_records_total",
+                    "rmt_logs_bytes_total",
+                    "rmt_logs_dropped_total")
+        for name in counters + ("rmt_logs_flush_seconds",):
+            assert name in mdefs.DEFS, name
+        mdefs.logs_records().inc(tags={"stream": "stdout"})
+        mdefs.logs_records().inc(tags={"stream": "logging"})
+        mdefs.logs_bytes().inc(512)
+        mdefs.logs_dropped().inc(tags={"reason": "buffer_full"})
+        mdefs.logs_dropped().inc(tags={"reason": "retention"})
+        mdefs.logs_flush_seconds().observe(0.002)
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in counters:
+            assert f"# TYPE {name} counter" in lines, name
+            assert any(line.startswith(f"# HELP {name} ") and
+                       len(line) > len(f"# HELP {name} ")
+                       for line in lines), name
+            assert any(line.startswith(name) and
+                       float(line.rsplit(" ", 1)[1]) > 0
+                       for line in lines), name
+        assert "# TYPE rmt_logs_flush_seconds histogram" in lines
+        assert any(line.startswith("rmt_logs_flush_seconds_count")
+                   for line in lines)
+        assert 'rmt_logs_records_total{stream="stdout"}' in text
+        assert 'rmt_logs_dropped_total{reason="buffer_full"}' in text
+
     def test_canonical_defs_construct(self):
         """Every declared instrument is constructible and re-entrant
         (aliases prior storage instead of shadowing it)."""
@@ -223,8 +255,11 @@ class TestWorkerExitFlush:
                 self.sent.append(msg)
                 return True
 
+        from ray_memory_management_tpu.utils import structlog
+
         timeline.clear()
         metrics.clear_registry()
+        structlog.clear()  # _flush_frame drains the structlog buffer too
         try:
             stub = SimpleNamespace(sender=_RecordingSender())
             stub._flush_frame = MethodType(Worker._flush_frame, stub)

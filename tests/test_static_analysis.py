@@ -26,7 +26,8 @@ FIXTURE_PKG = os.path.join(HERE, "analysis_fixtures", "pkg")
 FIXTURE_TESTS = os.path.join(HERE, "analysis_fixtures", "pkgtests")
 
 RULES = ("blocking-under-lock", "fault-site", "lock-discipline",
-         "metric-registry", "protocol-additivity", "trace-propagation")
+         "log-discipline", "metric-registry", "protocol-additivity",
+         "trace-propagation")
 
 
 # --------------------------------------------------------------- the tree
@@ -92,6 +93,16 @@ def test_fixture_protocol_additivity_fires(fixture_violations):
                                      "protocol-additivity")]
     assert any("'ghost_key'" in m and "no longer" in m for m in msgs)
     assert any("'new_key'" in m and "not registered" in m for m in msgs)
+
+
+def test_fixture_log_discipline_fires(fixture_violations):
+    hits = _hits(fixture_violations, "log-discipline")
+    assert len(hits) == 2, [v.format() for v in hits]
+    assert all(v.path.endswith("core/logs_bad.py") for v in hits)
+    msgs = [v.message for v in hits]
+    assert any("bare print()" in m for m in msgs)
+    assert any("f-string" in m for m in msgs)
+    # suppressed_print / suppressed_eager carry pragmas; lazy_ok is lazy
 
 
 def test_fixture_trace_propagation_fires(fixture_violations):
