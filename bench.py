@@ -406,6 +406,48 @@ def _locality_suite():
         return {"error": repr(e)}
 
 
+# device-tier-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): the zero-copy handoff
+# vs shm round-trip numbers (acceptance: >=10x at 64 MB, bytes_avoided
+# moved), demotion throughput, same-mesh ICI vs host-wire path, and the
+# eviction-pressure sweep.
+REQUIRED_DEVICE_FIELDS = (
+    "zero_copy_gbps", "shm_roundtrip_gbps", "zero_copy_speedup",
+    "bytes_avoided_mb", "demotion_gbps", "demotion_evictions",
+    "ici_gbps", "host_path_gbps", "ici_vs_host_speedup",
+    "ici_transfers", "eviction_sweep", "payload_mb", "trials",
+)
+
+
+def _device_suite():
+    """Device object tier (utils/device_bench.py); fault-isolated so a
+    failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.device_bench import (
+            run_device_suite,
+        )
+
+        out = run_device_suite()
+        print(
+            f"  device zero-copy ({out['payload_mb']} MB): "
+            f"{out['zero_copy_gbps']:.1f} GB/s vs "
+            f"{out['shm_roundtrip_gbps']:.1f} GB/s shm round trip "
+            f"({out['zero_copy_speedup']:.0f}x), avoided "
+            f"{out['bytes_avoided_mb']:.0f} MB", file=sys.stderr)
+        print(
+            f"  device demotion {out['demotion_gbps']:.1f} GB/s; "
+            f"same-mesh move {out['ici_gbps']:.1f} GB/s vs host path "
+            f"{out['host_path_gbps']:.1f} GB/s "
+            f"({out['ici_vs_host_speedup']:.0f}x)", file=sys.stderr)
+        missing = [k for k in REQUIRED_DEVICE_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  device suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 # tracing-suite fields every BENCH_DETAIL.json must carry
 # (tests/test_bench_format.py enforces the set): tasks/s on a no-op
 # fan-out with the trace plane on vs off, and the overhead percentage
@@ -634,6 +676,7 @@ def main() -> None:
     transfer = _transfer_suite()
     compression = _compression_suite()
     locality = _locality_suite()
+    device = _device_suite()
     tracing = _tracing_suite()
     logging_out = _logging_suite()
     elastic = _elastic_suite()
@@ -646,7 +689,7 @@ def main() -> None:
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
               "transfer": transfer, "compression": compression,
-              "locality": locality,
+              "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
               "elastic": elastic,
               "metrics": obs_metrics}
@@ -659,7 +702,7 @@ def main() -> None:
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "tpu", "transfer",
-                    "compression", "locality",
+                    "compression", "locality", "device",
                     "tracing", "logging", "elastic", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
@@ -667,12 +710,13 @@ def main() -> None:
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
-                        compression, logging=logging_out))
+                        compression, logging=logging_out, device=device))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
-                  elastic=None, compression=None, logging=None):
+                  elastic=None, compression=None, logging=None,
+                  device=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -715,6 +759,17 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             "speedup": locality["locality_speedup"],
             "bytes_avoided_mb": locality["locality_bytes_avoided_mb"],
             "prefetch_overlap_ms": locality["prefetch_overlap_ms"],
+        }
+    if device and "error" not in device:
+        # the device-tier acceptance numbers: zero-copy handoff beating
+        # the shm round trip (>=10x at 64 MB) with real bytes avoided,
+        # and the same-mesh move beating the host wire path
+        line["device"] = {
+            "zero_copy_gbps": device["zero_copy_gbps"],
+            "zero_copy_speedup": device["zero_copy_speedup"],
+            "bytes_avoided_mb": device["bytes_avoided_mb"],
+            "demotion_gbps": device["demotion_gbps"],
+            "ici_vs_host_speedup": device["ici_vs_host_speedup"],
         }
     if tracing and "error" not in tracing:
         # the trace-plane acceptance number: fan-out overhead (<=5%)
@@ -777,7 +832,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
         for k in ("compression", "elastic", "logging", "tracing",
-                  "locality", "transfer", "micro", "scale"):
+                  "device", "locality", "transfer", "micro", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

@@ -388,7 +388,13 @@ def put(value: Any, *, device: bool = False) -> ObjectRef:
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
-        *, timeout: Optional[float] = None):
+        *, timeout: Optional[float] = None, consume: bool = False):
+    """``consume=True`` is the device-tier donation read: the caller
+    asserts it is the LAST reader of a device object, the store drops
+    its pin and hands over the live buffer so the caller can donate it
+    into a pjit computation (``donate_argnums``) without a copy. The
+    ref is dead for device reads afterwards; non-device objects ignore
+    the flag."""
     single = isinstance(refs, ObjectRef)
     if not single and not isinstance(refs, (list, tuple)):
         raise TypeError(
@@ -398,9 +404,11 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     for r in ref_list:
         if not isinstance(r, ObjectRef):
             raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
-    values = _backend().get_objects(
-        [r.binary() for r in ref_list], timeout
-    )
+    ids = [r.binary() for r in ref_list]
+    if consume:
+        values = _backend().get_objects(ids, timeout, consume=True)
+    else:
+        values = _backend().get_objects(ids, timeout)
     return values[0] if single else values
 
 

@@ -82,6 +82,31 @@ _flag("transfer_broadcast_fanout", int, 2,
       "n-destination broadcast from source-bottlenecked O(n*size) into a "
       "pipelined O(size*log n) tree. 0 disables the gate.")
 
+# --- device (HBM) object tier ------------------------------------------------
+_flag("device_store_capacity_bytes", int, 0,
+      "HBM budget for the per-process device object store; putting past "
+      "it demotes least-recently-used UNPINNED device objects to the "
+      "host shm tier (which spills below itself as usual). 0 = auto: "
+      "60% of jax.local_devices() memory stats when the backend reports "
+      "them, else a 1 GiB fallback for CPU-backed arrays. Negative "
+      "disables eviction entirely (unbounded pinning).")
+_flag("device_demote_precision", str, "f32",
+      "Dtype-aware downcast applied when a float32 device object is "
+      "demoted to host: 'f32' keeps the exact bytes; 'bf16' writes the "
+      "PR 7 quantize envelope (half the host/spill bytes, values "
+      "round-tripped through bf16 truncation — rel err <= 2^-8). "
+      "Non-f32 payloads always demote exact.")
+_flag("device_promote_on_read", bool, True,
+      "Re-promote a demoted device object back into the device store on "
+      "its next device-side read (LRU re-entry; it can be demoted "
+      "again under pressure). Off leaves demoted objects host-resident.")
+_flag("device_ici_transfer", bool, True,
+      "Move device objects device-to-device with a jitted transfer "
+      "(compiled per shape/dtype/src/dst) when producer and consumer "
+      "sit on the same mesh, instead of bouncing through host "
+      "serialization; cross-mesh readers always fall back to the "
+      "striped host wire path.")
+
 # --- scheduling --------------------------------------------------------------
 _flag("scheduler_spread_threshold", float, 0.5,
       "Hybrid policy: pack onto the local/low-index nodes until utilization "

@@ -105,6 +105,14 @@ class GCS:
         # locality-aware leasing needs bytes, not just holder sets).
         # Entries live and die with object_locations.
         self.object_sizes: Dict[bytes, int] = {}  # guarded-by: _lock
+        # storage tier per (object, node): "hbm" marks a live device
+        # (accelerator) copy pinned by a process on that node — visible
+        # to locality scoring and the state API, but NOT host-readable
+        # (get_object_locations filters it so the transfer plane never
+        # tries to shm-read HBM). "shm" is the default host tier; a
+        # host copy written later (materialization/demotion) overwrites
+        # the tag. Entries live and die with object_locations.
+        self.object_tiers: Dict[bytes, Dict[NodeID, str]] = defaultdict(dict)  # guarded-by: _lock
         self._node_index = 0  # guarded-by: _lock
 
     # -- jobs ----------------------------------------------------------------
@@ -243,9 +251,11 @@ class GCS:
 
     # -- object directory ----------------------------------------------------
     def add_object_location(self, oid: bytes, node_id: NodeID,
-                            size: Optional[int] = None) -> None:
+                            size: Optional[int] = None,
+                            tier: str = "shm") -> None:
         with self._lock:
             self.object_locations[oid].add(node_id)
+            self.object_tiers[oid][node_id] = tier
             if size is not None:
                 self.object_sizes[oid] = size
 
@@ -254,28 +264,51 @@ class GCS:
             locs = self.object_locations.get(oid)
             if locs:
                 locs.discard(node_id)
+                tiers = self.object_tiers.get(oid)
+                if tiers:
+                    tiers.pop(node_id, None)
                 if not locs:
                     del self.object_locations[oid]
                     self.object_sizes.pop(oid, None)
+                    self.object_tiers.pop(oid, None)
+
+    def remove_device_location(self, oid: bytes, node_id: NodeID) -> None:
+        """Drop a holder only while its copy is still device-tier: the
+        owner process died or consumed the buffer. A host copy written
+        since (materialization overwrote the tag to 'shm') survives —
+        it lives in the node store, not the dead process."""
+        with self._lock:
+            if self.object_tiers.get(oid, {}).get(node_id) != "hbm":
+                return
+        self.remove_object_location(oid, node_id)
 
     def get_object_locations(self, oid: bytes) -> Set[NodeID]:
+        """HOST-READABLE holders only: device-tier (hbm) copies are live
+        process-local jax buffers the transfer plane cannot shm-read —
+        those readers go through the materialization path instead."""
         with self._lock:
-            return set(self.object_locations.get(oid, ()))
+            tiers = self.object_tiers.get(oid, {})
+            return {n for n in self.object_locations.get(oid, ())
+                    if tiers.get(n, "shm") != "hbm"}
 
     def locate_objects(self, oids) -> Dict[bytes, tuple]:
         """Batched directory lookup for the scheduler's locality pass:
-        ``{oid: (size_bytes, (holder NodeIDs...))}`` under ONE lock
-        acquisition (the router calls this once per scheduling batch, not
-        per oid per candidate node). Size is 0 when the directory never
-        learned it (the holder set is still valid — the scheduler just
-        can't weigh those bytes). Objects with no live directory entry
-        are absent from the result."""
+        ``{oid: (size_bytes, (holder NodeIDs...), {node: tier})}`` under
+        ONE lock acquisition (the router calls this once per scheduling
+        batch, not per oid per candidate node). Size is 0 when the
+        directory never learned it (the holder set is still valid — the
+        scheduler just can't weigh those bytes). Holders INCLUDE
+        device-tier (hbm) copies — an HBM-resident argument is the best
+        possible placement target — with the tier map telling readers
+        which holders are host-readable. Objects with no live directory
+        entry are absent from the result."""
         out: Dict[bytes, tuple] = {}
         with self._lock:
             for oid in oids:
                 locs = self.object_locations.get(oid)
                 if locs:
-                    out[oid] = (self.object_sizes.get(oid, 0), tuple(locs))
+                    out[oid] = (self.object_sizes.get(oid, 0), tuple(locs),
+                                dict(self.object_tiers.get(oid, {})))
         return out
 
     def prune_location(self, oid: bytes, node_id: NodeID) -> None:
@@ -309,6 +342,7 @@ class GCS:
             for oid in oids:
                 locs = self.object_locations.pop(oid, None)
                 self.object_sizes.pop(oid, None)
+                self.object_tiers.pop(oid, None)
                 if locs:
                     out[oid] = locs
         return out
@@ -320,8 +354,12 @@ class GCS:
         with self._lock:
             for oid, locs in list(self.object_locations.items()):
                 locs.discard(node_id)
+                tiers = self.object_tiers.get(oid)
+                if tiers:
+                    tiers.pop(node_id, None)
                 if not locs:
                     del self.object_locations[oid]
                     self.object_sizes.pop(oid, None)
+                    self.object_tiers.pop(oid, None)
                     orphaned.append(oid)
         return orphaned

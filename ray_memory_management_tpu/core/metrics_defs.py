@@ -74,6 +74,26 @@ DEFS: Dict[str, tuple] = {
         tag_keys=("node_id",))),
     "rmt_device_store_bytes": (Gauge, dict(
         description="Accelerator-resident object bytes (device store).")),
+    "rmt_device_objects_pinned": (Gauge, dict(
+        description="Objects currently resident in this process's "
+                    "device (HBM) tier.")),
+    "rmt_device_bytes_pinned": (Gauge, dict(
+        description="Bytes currently resident in this process's device "
+                    "(HBM) tier.")),
+    "rmt_device_evictions_total": (Counter, dict(
+        description="Device objects demoted out of the HBM tier under "
+                    "capacity pressure, by destination tier (shm = the "
+                    "host store's create/seal path; the spill plane "
+                    "takes over below it).",
+        tag_keys=("to_tier",))),
+    "rmt_device_zero_copy_hits_total": (Counter, dict(
+        description="Device-object reads served zero-copy from the "
+                    "live pinned jax.Array (no serialization, no host "
+                    "copy).")),
+    "rmt_device_ici_transfers_total": (Counter, dict(
+        description="Device objects moved device-to-device over the "
+                    "jitted same-mesh transfer path instead of the "
+                    "host wire.")),
     "rmt_objects_spilled_total": (Counter, dict(
         description="Objects spilled to external storage.")),
     "rmt_objects_spilled_bytes_total": (Counter, dict(
@@ -300,6 +320,26 @@ def object_store_bytes() -> Gauge:
 
 def device_store_bytes() -> Gauge:
     return get("rmt_device_store_bytes")
+
+
+def device_objects_pinned() -> Gauge:
+    return get("rmt_device_objects_pinned")
+
+
+def device_bytes_pinned() -> Gauge:
+    return get("rmt_device_bytes_pinned")
+
+
+def device_evictions() -> Counter:
+    return get("rmt_device_evictions_total")
+
+
+def device_zero_copy_hits() -> Counter:
+    return get("rmt_device_zero_copy_hits_total")
+
+
+def device_ici_transfers() -> Counter:
+    return get("rmt_device_ici_transfers_total")
 
 
 def objects_spilled() -> Counter:

@@ -43,7 +43,7 @@ class WorkerHandle:
                  "lease_resources", "visible_chips", "pending_msgs",
                  "death_processed", "send_lock", "steal_pending",
                  "re_inflight", "conda_key", "spawned_at",
-                 "_alive_checked_at")
+                 "_alive_checked_at", "device_mesh")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -72,6 +72,9 @@ class WorkerHandle:
         self.pending_msgs: List[dict] = []  # queued until registration
         self.spawned_at = 0.0  # set at spawn; boot latency at ready
         self._alive_checked_at = 0.0
+        # mesh fingerprint the worker reported with its first device
+        # seal: the ICI-route decision compares it with the consumer's
+        self.device_mesh: Optional[tuple] = None
 
     def alive(self) -> bool:
         # proc.poll() is a waitpid syscall; on the dispatch hot path it

@@ -12,6 +12,13 @@ Combines three reference components into the TPU-host store model:
     (src/ray/core_worker/store_provider/memory_store/memory_store.h:43) lives
     in the driver/worker runtime, not here.
 
+This shm tier is also the landing zone of DEVICE demotions: when the
+HBM tier (core/device_store.py) runs past its budget, LRU device
+objects arrive here through the same create/seal path as any put
+(optionally bf16-downcast via the codec demotion envelope), and from
+here the existing spill plane takes over — HBM → shm → spill, each
+tier evicting into the next.
+
 Allocation under pressure WAITS (bounded) instead of failing: capacity held
 by in-flight reader refs (executing tasks) or residency pins drains within
 milliseconds, and failing immediately turns a transient full store into a

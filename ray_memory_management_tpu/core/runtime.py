@@ -383,9 +383,19 @@ class Runtime:
         self.memory_store: Dict[bytes, bytes] = {}  # small objects (serialized)
         from .device_store import DeviceObjectStore
 
-        self.device_store = DeviceObjectStore()  # driver-pinned jax.Arrays
+        from .device_store import resolve_capacity
+
+        # driver-pinned jax.Arrays: a budgeted HBM tier that LRU-demotes
+        # unpinned entries into the head node's shm store (which spills
+        # below itself), bf16-downcasting f32 payloads when configured
+        self.device_store = DeviceObjectStore(
+            capacity_bytes=resolve_capacity(config),
+            on_demote=self._demote_device_object)
         # device-object ownership: oid -> "driver" | WorkerHandle
         self._device_locations: Dict[bytes, Any] = {}
+        # driver device objects demoted to host, eligible for
+        # re-promotion on their next device read
+        self._demoted_device: Set[bytes] = set()  # guarded-by: _lock
         self._materialize_futs: Dict[bytes, Future] = {}
         self._log_tails: Dict[Any, bytes] = {}  # worker id -> partial line
         self.futures: Dict[bytes, Future] = {}
@@ -1143,6 +1153,10 @@ class Runtime:
             self._on_actor_created(handle, msg)
         elif mtype == "device_materialized":
             self._on_device_materialized(handle, msg)
+        elif mtype == "device_demoted":
+            self._on_device_demoted(handle, msg)
+        elif mtype == "device_consumed":
+            self._on_device_consumed(handle, msg)
         elif mtype == "owned_put":
             # one-way registration of a worker-owned put: the worker
             # already minted the id and wrote its node store (zero
@@ -1912,11 +1926,15 @@ class Runtime:
         for spec, deps in deps_by_task:
             acc: Dict[NodeID, int] = {}
             for oid in deps:
-                size, holders = directory.get(oid, (0, ()))
+                size, holders, tiers = directory.get(oid, (0, (), {}))
                 if not size:
                     continue
                 for nid in holders:
-                    acc[nid] = acc.get(nid, 0) + size
+                    # device-resident args count double: running where
+                    # the HBM pin lives avoids the device→host
+                    # materialization on top of the wire transfer
+                    w = 2 if tiers.get(nid) == "hbm" else 1
+                    acc[nid] = acc.get(nid, 0) + size * w
             if acc:
                 out[spec.task_id] = acc
         return out
@@ -2733,12 +2751,7 @@ class Runtime:
         with self._lock:
             pending = len(self._waiting_deps)
         mdefs.scheduler_pending_args().set(float(pending))
-        dev_bytes = 0
-        for oid in self.device_store.ids():
-            n = self.device_store.nbytes(oid)
-            if n:
-                dev_bytes += n
-        mdefs.device_store_bytes().set(float(dev_bytes))
+        mdefs.device_store_bytes().set(float(self.device_store.total_bytes()))
 
     # --------------------------------------------------------- device objects
     def put_device_object(self, value: Any) -> bytes:
@@ -2751,12 +2764,21 @@ class Runtime:
                 "put(..., device=True) requires a jax.Array; got "
                 f"{type(value).__name__}")
         oid = ObjectID.for_put().binary()
-        self.device_store.put(oid, value)
         with self._lock:
             self._device_locations[oid] = "driver"
             fut = _SlimFuture()
             fut.set_result(True)
             self.futures[oid] = fut
+        # directory first, then the pin: a put over budget demotes LRU
+        # entries synchronously, and a demoted sibling's tier flip must
+        # not race this object's own registration
+        try:
+            nbytes = int(value.nbytes)
+        except Exception:  # noqa: BLE001
+            nbytes = 0
+        self.gcs.add_object_location(oid, self.head_node().node_id,
+                                     size=nbytes, tier="hbm")
+        self.device_store.put(oid, value)
         return oid
 
     def reserve_device_put(self, handle: WorkerHandle) -> bytes:
@@ -2768,7 +2790,20 @@ class Runtime:
             self.futures[oid] = _SlimFuture()  # resolved by device_put_sealed
         return oid
 
-    def seal_device_put(self, oid: bytes) -> None:
+    def seal_device_put(self, oid: bytes, handle: Optional[WorkerHandle] = None,
+                        size: Optional[int] = None,
+                        mesh: Optional[tuple] = None) -> None:
+        if handle is not None:
+            # the sealed device copy joins the object directory under
+            # its hbm tier tag: locality scoring sees the bytes, the
+            # transfer plane does not (get_object_locations filters
+            # device tiers), and state.list_objects reports the tier
+            self.gcs.add_object_location(oid, handle.node_id, size=size,
+                                         tier="hbm")
+            if mesh is not None:
+                # one fingerprint per worker process: the ICI-route
+                # decision compares it against the consumer's mesh
+                handle.device_mesh = tuple(mesh)
         with self._lock:
             fut = self.futures.get(oid)
         if fut is not None and not fut.done():
@@ -2794,6 +2829,7 @@ class Runtime:
             arr = self.device_store.get(oid)
             if arr is None:
                 return False
+            self._fire_device_materialize()
             nm = self.head_node()
             if not nm.store.contains(oid):
                 try:
@@ -2807,6 +2843,20 @@ class Runtime:
             return False
         if self.gcs.get_object_locations(oid):
             return True  # already materialized earlier
+        if self._device_route(loc) == "ici":
+            # producer shares this consumer's mesh: the object could ride
+            # a device-to-device collective instead of the host wire.
+            # Cross-process collectives need a cooperative mesh runtime
+            # on both sides (jax.distributed), which the in-process
+            # transfer plane cannot drive yet — fall through to host
+            # materialization, loudly, so the decision point is
+            # exercised end-to-end today and becomes a fast path when
+            # the collective lands.
+            events.emit(
+                "DEVICE_ICI_FALLBACK",
+                f"same-mesh device object {oid.hex()[:12]} moved over "
+                "the host path (no cooperative collective runtime)",
+                source="runtime")
         with self._lock:
             fut = self._materialize_futs.get(oid)
             if fut is None:
@@ -2840,6 +2890,31 @@ class Runtime:
             else:
                 fut.set_result(True)
 
+    def _on_device_demoted(self, handle: WorkerHandle, msg: dict) -> None:
+        """One-way notice that a worker's device tier demoted an object
+        to its node shm store under budget pressure. The directory tier
+        flips to shm (host-readable again) and the head stops routing
+        device reads at the worker — the normal shm/transfer plane now
+        owns the object."""
+        oid = msg["object_id"]
+        self.gcs.add_object_location(
+            oid, handle.node_id, size=msg.get("size"))
+        with self._lock:
+            if self._device_locations.get(oid) is handle:
+                del self._device_locations[oid]
+            self._demoted_device.add(oid)
+
+    def _on_device_consumed(self, handle: WorkerHandle, msg: dict) -> None:
+        """A worker took a device entry for donation (consume=True):
+        no copy survives there, so drop the routing and the hbm tag.
+        Later gets fall through to any host copy, else lineage."""
+        oid = msg["object_id"]
+        with self._lock:
+            if self._device_locations.get(oid) is handle:
+                del self._device_locations[oid]
+            self._demoted_device.discard(oid)
+        self.gcs.remove_device_location(oid, handle.node_id)
+
     def _drop_device_location(self, handle: WorkerHandle) -> None:
         """Owner process died: its device objects are gone; gets fall
         through to lineage recovery."""
@@ -2852,6 +2927,112 @@ class Runtime:
                 if fut is not None and not fut.done():
                     fut.set_exception(ObjectLostError(
                         oid.hex(), "device-object owner process died"))
+        for oid in dead:
+            # drop the directory's hbm tag for the dead process; a host
+            # copy materialized earlier (tier flipped to shm) survives
+            self.gcs.remove_device_location(oid, handle.node_id)
+
+    @staticmethod
+    def _fire_device_materialize() -> None:
+        """Injectable fault site on every device<->host movement
+        (on-demand materialization and host->device re-promotion)."""
+        from ..utils import faults
+
+        act = faults.fire("device.materialize")
+        if act is not None:
+            if act.mode == "stall":
+                act.sleep()
+            elif act.mode in ("error", "drop"):
+                act.raise_()
+
+    def _device_route(self, loc) -> str:
+        """Transfer route for a device object owned by ``loc``:
+        'local' (same process — zero-copy / donation), 'ici' (owner
+        shares this process's mesh — device-to-device move), or 'host'
+        (materialize + v2 striped wire). Decided from the mesh
+        fingerprint the owner registered at seal time."""
+        if loc == "driver":
+            return "local"
+        if not self.config.device_ici_transfer:
+            return "host"
+        from . import transfer as xfer
+
+        if xfer.same_mesh(getattr(loc, "device_mesh", None),
+                          xfer.mesh_fingerprint()):
+            return "ici"
+        return "host"
+
+    def _demote_device_object(self, oid: bytes, arr: Any) -> bool:
+        """Device→host demotion (the device store's LRU eviction
+        callback): write the serialized value — bf16-downcast when
+        configured — through the head node store's create/seal path and
+        flip the directory tier to shm; the spill plane takes over below
+        shm. Returns False (object stays device-resident) on any IO
+        failure."""
+        data = ser.serialize_device_demotion(
+            arr, self.config.device_demote_precision)
+        nm = self.head_node()
+        if not nm.store.contains(oid):
+            try:
+                nm.store.put_serialized(oid, data)
+            except ValueError:
+                pass  # concurrent reader materialized it first
+        self.gcs.add_object_location(oid, nm.node_id,
+                                     size=data.total_size)
+        with self._lock:
+            self._device_locations.pop(oid, None)
+            self._demoted_device.add(oid)
+        return True
+
+    def _maybe_promote_device(self, oid: bytes, value: Any):
+        """Re-promotion on device read: a get() that found host bytes
+        for a previously demoted device object re-pins the rehydrated
+        array so the NEXT consumer is zero-copy again (LRU re-entry —
+        pressure can demote it right back)."""
+        with self._lock:
+            if oid not in self._demoted_device:
+                return value
+        if not self.config.device_promote_on_read:
+            return value
+        from .device_store import is_device_array
+
+        if not is_device_array(value):
+            return value
+        try:
+            self._fire_device_materialize()
+        except Exception:  # noqa: BLE001 — injected: skip the promotion
+            return value
+        with self._lock:
+            self._demoted_device.discard(oid)
+            self._device_locations[oid] = "driver"
+        # the host copy stays resident (and keeps its shm tier tag —
+        # flipping it to hbm would hide it from host readers); the
+        # re-pinned array just makes the next local read zero-copy
+        self.device_store.put(oid, value)
+        return value
+
+    def _forget_device_object(self, oid: bytes) -> None:
+        """A consume=True get took the pinned buffer for donation: the
+        device copy no longer exists anywhere the runtime can hand out."""
+        with self._lock:
+            self._device_locations.pop(oid, None)
+            self._demoted_device.discard(oid)
+        self.gcs.remove_device_location(oid, self.head_node().node_id)
+
+    def move_device_object(self, oid: bytes, device) -> bool:
+        """Driver-side ICI move: relocate a driver-pinned device object
+        onto ``device`` with the jitted device-to-device transfer (the
+        same-mesh fast path the bench headlines). Zero-copy readers keep
+        working against the moved buffer. False if the object is not
+        pinned in this process."""
+        arr = self.device_store.get(oid)
+        if arr is None:
+            return False
+        from . import transfer as xfer
+
+        moved = xfer.ici_move(arr, device)
+        self.device_store.put(oid, moved)
+        return True
 
     # ------------------------------------------------------------ object api
     def put_object(self, value: Any) -> bytes:
@@ -2937,11 +3118,12 @@ class Runtime:
         self.cancel(oid, force)
 
     def get_objects(self, oids: List[bytes],
-                    timeout: Optional[float] = None) -> List[Any]:
+                    timeout: Optional[float] = None,
+                    consume: bool = False) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         out: Dict[bytes, Any] = {}
         for oid in dict.fromkeys(oids):
-            out[oid] = self._get_one(oid, deadline)
+            out[oid] = self._get_one(oid, deadline, consume=consume)
         results = []
         for oid in oids:
             v = out[oid]
@@ -2950,8 +3132,18 @@ class Runtime:
             results.append(v)
         return results
 
-    def _get_one(self, oid: bytes, deadline: Optional[float]):
-        # driver-pinned device object: zero-copy return of the live array
+    def _get_one(self, oid: bytes, deadline: Optional[float],
+                 consume: bool = False):
+        # driver-pinned device object: zero-copy return of the live
+        # array. consume=True is the last-reader donation path — the
+        # store drops its pin and the directory forgets the device copy
+        # so the caller can donate the buffer into its pjit computation
+        # (a later get of the ref is an object-lost error, by contract).
+        if consume:
+            arr = self.device_store.take(oid)
+            if arr is not None:
+                self._forget_device_object(oid)
+                return arr
         arr = self.device_store.get(oid)
         if arr is not None:
             return arr
@@ -2977,7 +3169,7 @@ class Runtime:
                 return ser.loads(data)
             value, found = self._read_from_stores(oid)
             if found:
-                return value
+                return self._maybe_promote_device(oid, value)
             # device-resident elsewhere: materialize device→host, re-read
             if self._ensure_device_materialized(oid):
                 value, found = self._read_from_stores(oid)
@@ -3279,7 +3471,15 @@ class Runtime:
                 return
             del self.local_refs[oid]
             self._deferred_frees.append(oid)
-            nudge = len(self._deferred_frees) == 128
+            # wake immediately for a DEVICE object (its HBM stays pinned
+            # until the flush — latency there is device memory held
+            # hostage) and at the batch threshold; host-object frees
+            # keep the lazy window and drain on the router's next
+            # natural wakeup. The _device_locations probe is a lock-free
+            # dict read (can't take _lock under _ref_mu); a stale answer
+            # only costs one spurious or slightly-late wakeup.
+            nudge = (oid in self._device_locations
+                     or len(self._deferred_frees) == 128)
         if nudge:
             self._wakeup()
 
@@ -3373,6 +3573,7 @@ class Runtime:
         with self._lock:
             for oid in oids:
                 loc = self._device_locations.pop(oid, None)
+                self._demoted_device.discard(oid)
                 self.memory_store.pop(oid, None)  # value is dead either way
                 task_id = self.lineage.get(oid)
                 if task_id is not None:
@@ -3443,7 +3644,9 @@ class Runtime:
             elif mtype == "device_put":
                 reply["object_id"] = self.reserve_device_put(handle)
             elif mtype == "device_put_sealed":
-                self.seal_device_put(msg["object_id"])
+                self.seal_device_put(msg["object_id"], handle,
+                                     size=msg.get("size"),
+                                     mesh=msg.get("mesh"))
             elif mtype == "wait":
                 ready, not_ready = self.wait(
                     msg["oids"], msg["num_returns"], msg["timeout"]

@@ -97,7 +97,10 @@ class ClusterScheduler:
         locality_min_bytes of the task's args; the weighted score trades
         resident bytes against utilization and dispatch-queue depth so a
         busy holder loses to an idle peer once the queue-delay cost
-        outweighs the transfer it avoids."""
+        outweighs the transfer it avoids. Device-tier (HBM-pinned) args
+        arrive pre-weighted from _batch_locality — the holder of a live
+        device pin counts the bytes double, since placing elsewhere pays
+        a device→host materialization before the wire hop."""
         w = self.config.scheduler_locality_weight
         if not locality or w <= 0:
             return None

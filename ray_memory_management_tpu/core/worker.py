@@ -388,12 +388,24 @@ class WorkerRuntimeProxy:
         reply = self._request({"type": "create_actor", "payload": payload})
         return reply["actor_id"]
 
-    def get_objects(self, oids: List[bytes], timeout: Optional[float] = None):
+    def get_objects(self, oids: List[bytes], timeout: Optional[float] = None,
+                    consume: bool = False):
         """Resolve objects: local store first, else ask the owner (which
-        transfers/restores/replies inline for memory-store values)."""
+        transfers/restores/replies inline for memory-store values).
+        ``consume=True`` TAKES device entries pinned in this process (the
+        last-reader donation path) instead of reading them zero-copy."""
         out: Dict[bytes, Any] = {}
         missing: List[bytes] = []
         for oid in set(oids):
+            if consume:
+                arr = self._worker.device_store.take(oid)
+                if arr is not None:
+                    # one-way: the head drops its device routing for the
+                    # oid (the buffer is being donated; no copy survives)
+                    self._worker.sender.send(
+                        {"type": "device_consumed", "object_id": oid})
+                    out[oid] = arr
+                    continue
             # device objects pinned in THIS process come back zero-copy
             arr = self._worker.device_store.get(oid)
             if arr is not None:
@@ -401,7 +413,8 @@ class WorkerRuntimeProxy:
                 continue
             view = self._worker.store.get(oid)
             if view is not None:
-                out[oid] = self._worker.decode_value(view, pin=oid)
+                out[oid] = self._maybe_repromote(
+                    oid, self._worker.decode_value(view, pin=oid))
             else:
                 missing.append(oid)
         attempt = 0
@@ -436,6 +449,33 @@ class WorkerRuntimeProxy:
                     )
                 time.sleep(0.05 * attempt)
         return [out[oid] for oid in oids]
+
+    def _maybe_repromote(self, oid: bytes, value: Any):
+        """Re-promotion on next device read: an object THIS worker
+        demoted under budget pressure comes back as a live jax array
+        (the demotion envelope rehydrates in decode) — re-pin it so
+        subsequent local reads are zero-copy again. Movement back into
+        HBM carries the device.materialize fault site; an injected
+        error skips the re-pin (the host copy still serves the read)."""
+        from ..config import global_config
+        from .device_store import is_device_array
+
+        worker = self._worker
+        if oid not in worker._demoted_device:
+            return value
+        if not global_config().device_promote_on_read \
+                or not is_device_array(value):
+            worker._demoted_device.discard(oid)
+            return value
+        act = faults.fire("device.materialize")
+        if act is not None:
+            if act.mode == "stall":
+                act.sleep()
+            else:
+                return value  # injected error/drop: serve the host copy
+        worker._demoted_device.discard(oid)
+        worker.device_store.put(oid, value)
+        return value
 
     def _direct_store_put(self, data, own: bool) -> bytes:
         """Shared body of the decentralized put paths: mint the id in
@@ -509,10 +549,19 @@ class WorkerRuntimeProxy:
             raise TypeError(
                 "put(..., device=True) requires a jax.Array; got "
                 f"{type(value).__name__}")
+        from . import transfer as xfer
+
         reply = self._request({"type": "device_put"})
         oid = reply["object_id"]
+        try:
+            nbytes = int(value.nbytes)
+        except Exception:  # noqa: BLE001
+            nbytes = 0
         self._worker.device_store.put(oid, value)
-        self._request({"type": "device_put_sealed", "object_id": oid})
+        # the seal carries size (locality scoring sees HBM bytes) and the
+        # producer's mesh fingerprint (the head's ICI-vs-host route input)
+        self._request({"type": "device_put_sealed", "object_id": oid,
+                       "size": nbytes, "mesh": xfer.mesh_fingerprint()})
         return oid
 
     def put_serialized_arg(self, data) -> bytes:
@@ -598,13 +647,21 @@ class _ActorState:
 class Worker:
     def __init__(self, conn, worker_id: bytes, node_id: bytes,
                  store_name: str, inline_limit: int):
-        from .device_store import DeviceObjectStore
+        from ..config import global_config
+        from .device_store import DeviceObjectStore, resolve_capacity
 
         self.conn = conn
         self.worker_id = worker_id
         self.node_id = node_id
         self.store = StoreClient(store_name)
-        self.device_store = DeviceObjectStore()
+        # workers see the env-driven config (RMT_* vars travel through the
+        # pool spawn), so capacity/precision knobs apply per-process
+        self.device_store = DeviceObjectStore(
+            capacity_bytes=resolve_capacity(global_config()),
+            on_demote=self._demote_device_object)
+        # oids this process demoted (re-promotion candidates on read);
+        # benign races only — a miss just skips one re-pin
+        self._demoted_device: set = set()
         self.inline_limit = inline_limit
         self.sender = _ReplySender(conn)
         self.proxy = WorkerRuntimeProxy(self)
@@ -838,6 +895,12 @@ class Worker:
         normal object plane (device_store.py design)."""
         oid = msg["object_id"]
         try:
+            act = faults.fire("device.materialize")
+            if act is not None:
+                if act.mode == "stall":
+                    act.sleep()
+                else:
+                    act.raise_()
             arr = self.device_store.get(oid)
             if arr is None:
                 raise KeyError(
@@ -849,6 +912,29 @@ class Worker:
             reply = {"type": "device_materialized", "object_id": oid,
                      "error": self._encode_error("materialize_device", e)}
         self.sender.send(reply)
+
+    def _demote_device_object(self, oid: bytes, arr: Any) -> bool:
+        """Budget-pressure demotion callback (device_store.on_demote):
+        HBM → this node's shm tier, optionally bf16-downcast. Runs on
+        whichever thread overfilled the store; a full shm store defers
+        the eviction (return False — the entry stays device-resident)."""
+        from ..config import global_config
+        from ..native import ShmStoreFullError
+        from ..serialization import serialize_device_demotion
+
+        data = serialize_device_demotion(
+            arr, global_config().device_demote_precision)
+        try:
+            self.store.put_serialized(oid, data)
+        except ShmStoreFullError:
+            return False
+        self._demoted_device.add(oid)
+        # one-way notice: the head flips the directory tier to shm and
+        # stops routing device reads here (pipe FIFO orders it before any
+        # later frame referencing the oid)
+        self.sender.send({"type": "device_demoted", "object_id": oid,
+                          "size": data.total_size})
+        return True
 
     def create_actor(self, msg: dict) -> None:
         actor_id = msg["actor_id"]
@@ -1178,6 +1264,7 @@ class Worker:
             })
         elif mtype == "free_device":
             self.device_store.delete(msg["object_id"])
+            self._demoted_device.discard(msg["object_id"])
         elif mtype == "ping":
             self.sender.send({"type": "pong"})
         elif mtype == "shutdown":

@@ -101,6 +101,49 @@ def _rebuild_jax_array(np_value):
     return jnp.asarray(np_value)
 
 
+def _rehydrate_demoted(payload):
+    """Unpickle hook for :class:`DemotedDeviceArray`: dequantize the
+    PR 7 envelope and land the value back as a jax.Array — every reader
+    of a demoted device object sees an array, never the envelope."""
+    import jax.numpy as jnp
+
+    from .core.codec import dequantize_array
+
+    return jnp.asarray(dequantize_array(payload))
+
+
+class DemotedDeviceArray:
+    """Host-side envelope for a device object demoted with a dtype-aware
+    downcast (``device_demote_precision=bf16``): carries the PR 7
+    quantize payload and unpickles STRAIGHT to the rehydrated jax.Array
+    via ``__reduce__`` — consumers on the normal get path are oblivious
+    to the demotion codec."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload
+
+    def __reduce__(self):
+        return (_rehydrate_demoted, (self.payload,))
+
+
+def serialize_device_demotion(array, precision: str) -> "SerializedObject":
+    """Device→host demotion serializer: float32 payloads honor the
+    configured downcast (bf16 halves the host/spill bytes through the
+    PR 7 quantize envelope, rel err <= 2^-8); everything else demotes
+    exact through the normal jax-aware path."""
+    import numpy as np
+
+    np_value = np.asarray(array)
+    if precision == "bf16" and np_value.dtype == np.float32:
+        from .core.codec import quantize_array
+
+        return serialize(DemotedDeviceArray(
+            quantize_array(np_value, "bf16")))
+    return serialize(array)
+
+
 def _align(offset: int) -> int:
     return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
 

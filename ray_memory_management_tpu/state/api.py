@@ -149,13 +149,20 @@ def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
                 spilled_by_node[node_id] = set(store._spilled)
         except Exception:
             continue
-    for oid, (size, holders) in located.items():
+    for oid, (size, holders, tiers) in located.items():
         for node_id in holders:
-            spilled = spilled_by_node.get(node_id, ())
+            tier = tiers.get(node_id, "shm")
+            if tier == "hbm":
+                where = "device"  # live HBM pin (process-local)
+            elif oid in spilled_by_node.get(node_id, ()):
+                where = "spilled"
+            else:
+                where = "store"
             rows.append({
                 "object_id": oid.hex(),
                 "size_bytes": size or None,
-                "where": "spilled" if oid in spilled else "store",
+                "where": where,
+                "tier": tier,
                 "node_id": node_id.hex(),
             })
     return _apply_filters(rows, filters)[:limit]

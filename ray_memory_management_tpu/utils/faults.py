@@ -21,6 +21,14 @@ control planes::
     worker.exec        worker-side task execution    (error/stall/drop)
     checkpoint.save    train checkpoint durable write (error/stall/corrupt/drop)
     checkpoint.restore train checkpoint load/verify   (error/stall/corrupt/drop)
+    device.materialize device<->host object movement  (error/stall/drop):
+                       on-demand device→host materialization for remote
+                       readers and host→device re-promotion on a device
+                       read of a demoted object
+    device.evict       capacity-driven HBM→host demotion (error/stall/drop):
+                       an injected error defers the eviction — the object
+                       stays device-resident and readable (pressure causes
+                       slowness, never loss)
 
 Each site × mode carries a probability, an optional activation offset
 (``after``: skip the first N hits) and budget (``max``: stop after N
@@ -62,6 +70,7 @@ SITES = (
     "transfer.send", "transfer.recv", "transfer.dial",
     "spill.write", "spill.read", "control.dispatch", "worker.exec",
     "checkpoint.save", "checkpoint.restore",
+    "device.materialize", "device.evict",
 )
 
 

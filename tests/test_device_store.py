@@ -50,8 +50,14 @@ class TestDriverDeviceObjects:
         assert rt.device_store.contains(oid)
         del ref
         import gc
+        import time
 
         gc.collect()
+        # frees batch through the router's deferred buffer; the drop
+        # nudges it, but the flush lands on the router thread — poll
+        deadline = time.time() + 5
+        while rt.device_store.contains(oid) and time.time() < deadline:
+            time.sleep(0.02)
         assert not rt.device_store.contains(oid)
 
 
@@ -130,3 +136,297 @@ class TestWorkerDeviceObjects:
 
         time.sleep(0.3)
         np.testing.assert_array_equal(np.asarray(rmt.get(ref)), first)
+
+
+def _init_small(capacity=8192, **kw):
+    from ray_memory_management_tpu.config import Config
+
+    return rmt.init(num_cpus=2, _config=Config(
+        device_store_capacity_bytes=capacity, **kw))
+
+
+class TestTieredEviction:
+    """HBM → shm demotion under a byte budget (device_store_capacity_bytes):
+    LRU victim choice, refcount pins, bf16 downcast envelopes,
+    re-promotion, and the device.evict fault site (injected errors DEFER
+    the eviction — pressure causes slowness, never loss)."""
+
+    def teardown_method(self):
+        rmt.shutdown()
+
+    def test_put_over_budget_demotes_lru(self):
+        rt = _init_small(capacity=8192)  # two 4 KiB payloads
+        refs = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                for i in range(3)]
+        assert rt.device_store.count() == 2
+        assert not rt.device_store.contains(refs[0].binary())  # LRU went
+        # the demoted object is still readable (host shm copy)
+        assert rmt.get(refs[0]).shape == (1024,)
+
+    def test_refcount_pin_blocks_eviction(self):
+        rt = _init_small(capacity=8192)
+        a = rmt.put(_cpu_array((1024,), seed=0), device=True)
+        assert rt.device_store.pin(a.binary())
+        b = rmt.put(_cpu_array((1024,), seed=1), device=True)
+        c = rmt.put(_cpu_array((1024,), seed=2), device=True)
+        assert b is not None  # keep the victim's ref alive
+        # the pinned LRU entry was skipped; the unpinned middle one went
+        assert rt.device_store.contains(a.binary())
+        assert rt.device_store.contains(c.binary())
+        assert rt.device_store.count() == 2
+        rt.device_store.unpin(a.binary())
+        assert rt.device_store.pin_count(a.binary()) == 0
+
+    def test_lru_order_respects_reads(self):
+        rt = _init_small(capacity=8192)
+        a = rmt.put(_cpu_array((1024,), seed=0), device=True)
+        b = rmt.put(_cpu_array((1024,), seed=1), device=True)
+        rmt.get(a)  # refresh a's recency: b is now the LRU victim
+        c = rmt.put(_cpu_array((1024,), seed=2), device=True)
+        assert c is not None
+        assert rt.device_store.contains(a.binary())
+        assert not rt.device_store.contains(b.binary())
+
+    def test_bf16_demotion_round_trip_error_bound(self):
+        rt = _init_small(capacity=8192, device_demote_precision="bf16")
+        src = np.random.default_rng(7).random(1024).astype(np.float32)
+        import jax.numpy as jnp
+
+        a = rmt.put(jnp.asarray(src), device=True)
+        # fillers stay referenced: a dropped ref frees (router nudge) and
+        # releases the very pressure the test is creating
+        fillers = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                   for i in (1, 2)]
+        assert not rt.device_store.contains(a.binary())  # demoted
+        back = np.asarray(rmt.get(a))
+        assert back.dtype == np.float32  # envelope rehydrates dtype
+        # bf16 truncation bound: 8 mantissa bits on values in [0, 1)
+        assert float(np.max(np.abs(back - src))) <= 2 ** -8
+
+    def test_demoted_object_repromotes_on_read(self):
+        rt = _init_small(capacity=8192)
+        a = rmt.put(_cpu_array((1024,), seed=0), device=True)
+        fillers = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                   for i in (1, 2)]
+        assert len(fillers) == 2
+        assert not rt.device_store.contains(a.binary())
+        got = rmt.get(a)  # re-promotion on next device read
+        from ray_memory_management_tpu.core.device_store import (
+            is_device_array,
+        )
+
+        assert is_device_array(got)
+        assert rt.device_store.contains(a.binary())
+
+    def test_evict_fault_defers_not_loses(self):
+        from ray_memory_management_tpu.utils import faults
+
+        rt = _init_small(capacity=8192)
+        refs = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                for i in range(2)]
+        faults.configure("device.evict:error:max=1", seed=3)
+        try:
+            late = rmt.put(_cpu_array((1024,), seed=9), device=True)
+            # the injected error deferred the demotion: every object is
+            # still device-resident (over budget) and readable
+            assert rt.device_store.count() == 3
+            for r in (*refs, late):
+                assert rmt.get(r).shape == (1024,)
+        finally:
+            faults.configure("")
+
+    def test_materialize_fault_skips_promotion(self):
+        from ray_memory_management_tpu.utils import faults
+
+        rt = _init_small(capacity=8192)
+        a = rmt.put(_cpu_array((1024,), seed=0), device=True)
+        fillers = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                   for i in (1, 2)]
+        assert len(fillers) == 2
+        assert not rt.device_store.contains(a.binary())
+        faults.configure("device.materialize:error:max=1", seed=4)
+        try:
+            got = rmt.get(a)  # host copy still serves the read
+            assert got.shape == (1024,)
+            assert not rt.device_store.contains(a.binary())
+        finally:
+            faults.configure("")
+
+    def test_promote_on_read_disabled(self):
+        rt = _init_small(capacity=8192, device_promote_on_read=False)
+        a = rmt.put(_cpu_array((1024,), seed=0), device=True)
+        fillers = [rmt.put(_cpu_array((1024,), seed=i), device=True)
+                   for i in (1, 2)]
+        assert len(fillers) == 2
+        assert not rt.device_store.contains(a.binary())
+        assert rmt.get(a).shape == (1024,)
+        assert not rt.device_store.contains(a.binary())
+
+
+class TestDonationConsume:
+    """consume=True: the last-reader get that TAKES the device entry so
+    the caller can donate the buffer into a pjit computation."""
+
+    def test_consume_returns_live_buffer_and_unpins(self, rmt_start_regular):
+        rt = rmt_start_regular
+        arr = _cpu_array((256,), seed=5)
+        ref = rmt.put(arr, device=True)
+        got = rmt.get(ref, consume=True)
+        assert got is arr
+        assert not rt.device_store.contains(ref.binary())
+
+    def test_consumed_ref_is_dead(self, rmt_start_regular):
+        ref = rmt.put(_cpu_array((256,), seed=6), device=True)
+        rmt.get(ref, consume=True)
+        from ray_memory_management_tpu.exceptions import ObjectLostError
+
+        with pytest.raises(ObjectLostError):
+            rmt.get(ref, timeout=2)
+
+    def test_consumed_buffer_donatable(self, rmt_start_regular):
+        """The taken buffer feeds a donated jit computation — the
+        zero-allocation handoff the consume path exists for."""
+        import jax
+        import jax.numpy as jnp
+
+        ref = rmt.put(jnp.ones(512, dtype=jnp.float32), device=True)
+        x = rmt.get(ref, consume=True)
+        step = jax.jit(lambda v: v * 2.0, donate_argnums=(0,))
+        out = np.asarray(step(x))
+        np.testing.assert_array_equal(out, np.full(512, 2.0, np.float32))
+
+    def test_consume_ignored_for_host_objects(self, rmt_start_regular):
+        ref = rmt.put({"k": 1})
+        assert rmt.get(ref, consume=True) == {"k": 1}
+        assert rmt.get(ref) == {"k": 1}  # still alive
+
+
+class TestICITransfer:
+    """Same-mesh device-to-device movement (the ICI path) and the host
+    fallback when producer and consumer share no mesh."""
+
+    def test_move_device_object_same_mesh(self, rmt_start_regular):
+        import jax
+
+        from ray_memory_management_tpu.core import metrics_defs as mdefs
+
+        rt = rmt_start_regular
+        devs = jax.local_devices()
+        if len(devs) < 2:
+            pytest.skip("needs the virtual 8-device CPU mesh")
+        before = sum(mdefs.device_ici_transfers().series().values())
+        ref = rmt.put(_cpu_array((128,), seed=8), device=True)
+        assert rt.move_device_object(ref.binary(), devs[1])
+        moved = rt.device_store.get(ref.binary())
+        assert list(moved.devices())[0] == devs[1]
+        after = sum(mdefs.device_ici_transfers().series().values())
+        assert after == before + 1
+
+    def test_mesh_fingerprint_differs_across_processes(self,
+                                                       rmt_start_regular):
+        from ray_memory_management_tpu.core import transfer as xfer
+
+        @rmt.remote
+        def fp():
+            from ray_memory_management_tpu.core import transfer as x
+
+            return x.mesh_fingerprint()
+
+        theirs = rmt.get(fp.remote())
+        ours = xfer.mesh_fingerprint()
+        # same host, same devices — but no shared runtime: the process
+        # token keeps the fingerprints apart, forcing the host fallback
+        assert theirs != ours
+        assert not xfer.same_mesh(theirs, ours)
+
+    def test_ici_fallback_without_shared_mesh(self, rmt_start_regular):
+        """Producer and consumer in different processes share no mesh:
+        the read falls back to the striped host path (materialize +
+        shm), and the ICI counter does not move."""
+        from ray_memory_management_tpu.core import metrics_defs as mdefs
+
+        @rmt.remote
+        class Producer:
+            def make(self):
+                import jax.numpy as jnp
+
+                return rmt.put(jnp.full((64,), 9.0), device=True)
+
+        before = sum(mdefs.device_ici_transfers().series().values())
+        p = Producer.remote()
+        ref = rmt.get(p.make.remote())
+        np.testing.assert_array_equal(
+            np.asarray(rmt.get(ref)), np.full(64, 9.0, np.float32))
+        after = sum(mdefs.device_ici_transfers().series().values())
+        assert after == before  # host path, not ICI
+        rmt.kill(p)
+
+    def test_ici_move_identity_same_device(self, rmt_start_regular):
+        import jax
+
+        from ray_memory_management_tpu.core import transfer as xfer
+
+        arr = _cpu_array((32,), seed=9)
+        out = xfer.ici_move(arr, jax.local_devices()[0])
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+class TestDeviceTierDirectory:
+    """The GCS directory tags device holders with tier 'hbm' — visible
+    to locality scoring and the state API, filtered from host reads."""
+
+    def test_list_objects_reports_device_tier(self, rmt_start_regular):
+        from ray_memory_management_tpu.state import api as state_api
+
+        ref = rmt.put(_cpu_array((2048,), seed=10), device=True)
+        rows = [r for r in state_api.list_objects()
+                if r["object_id"] == ref.binary().hex()]
+        assert rows and rows[0]["where"] == "device"
+        assert rows[0]["tier"] == "hbm"
+        assert rows[0]["size_bytes"] == 8192
+
+    def test_materialized_copy_flips_tier_to_shm(self, rmt_start_regular):
+        from ray_memory_management_tpu.state import api as state_api
+
+        @rmt.remote
+        class Owner:
+            def make(self):
+                import jax.numpy as jnp
+
+                return rmt.put(jnp.ones(2048, dtype=jnp.float32),
+                               device=True)
+
+        o = Owner.remote()
+        ref = rmt.get(o.make.remote())
+        rmt.get(ref)  # forces materialization to the owner's node shm
+        rows = [r for r in state_api.list_objects()
+                if r["object_id"] == ref.binary().hex()]
+        assert rows and {r["tier"] for r in rows} == {"shm"}
+        rmt.kill(o)
+
+    def test_locality_scores_hbm_bytes(self, rmt_start_regular):
+        """_batch_locality counts device-resident args (double weight:
+        placing elsewhere pays materialization + wire)."""
+        rt = rmt_start_regular
+        ref = rmt.put(_cpu_array((4096,), seed=11), device=True)
+
+        class _Spec:
+            task_id = b"t" * 16
+
+        spec = _Spec()
+        rt_deps = rt._ref_deps
+
+        class _FakeSpec:
+            task_id = b"t" * 16
+            args = ()
+            kwargs = {}
+
+        deps = {ref.binary()}
+        old = rt._ref_deps
+        rt._ref_deps = lambda s: deps if s is spec else old(s)
+        try:
+            out = rt._batch_locality([spec])
+        finally:
+            rt._ref_deps = rt_deps
+        head = rt.head_node().node_id
+        assert out[spec.task_id][head] == 2 * 16384  # hbm counts double

@@ -171,6 +171,45 @@ class TestPrometheusExposition:
         assert 'rmt_logs_records_total{stream="stdout"}' in text
         assert 'rmt_logs_dropped_total{reason="buffer_full"}' in text
 
+    def test_device_series_in_exposition(self):
+        """Golden coverage for the device-tier series: pinned-object and
+        pinned-byte gauges, the eviction counter (tagged by destination
+        tier), the zero-copy hit counter, and the ICI transfer counter
+        must all surface in the exposition once they have moved."""
+        counters = ("rmt_device_zero_copy_hits_total",
+                    "rmt_device_ici_transfers_total")
+        gauges = ("rmt_device_objects_pinned", "rmt_device_bytes_pinned")
+        for name in counters:
+            assert name in mdefs.DEFS, name
+            mdefs.get(name).inc(1)
+        for name in gauges:
+            assert name in mdefs.DEFS, name
+            mdefs.get(name).set(3.0)
+        assert "rmt_device_evictions_total" in mdefs.DEFS
+        mdefs.get("rmt_device_evictions_total").inc(
+            1, tags={"to_tier": "shm"})
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in counters:
+            assert f"# TYPE {name} counter" in lines, name
+            assert any(line.startswith(name) and
+                       float(line.rsplit(" ", 1)[1]) > 0
+                       for line in lines), name
+        for name in gauges:
+            assert f"# TYPE {name} gauge" in lines, name
+            assert f"{name} 3.0" in lines, name
+        assert "# TYPE rmt_device_evictions_total counter" in lines
+        assert any(
+            line.startswith('rmt_device_evictions_total{to_tier="shm"}')
+            and float(line.rsplit(" ", 1)[1]) > 0 for line in lines)
+        # the accessors alias the registered instruments' storage
+        before = sum(mdefs.get(
+            "rmt_device_zero_copy_hits_total").series().values())
+        mdefs.device_zero_copy_hits().inc(2)
+        after = sum(mdefs.get(
+            "rmt_device_zero_copy_hits_total").series().values())
+        assert after == before + 2
+
     def test_canonical_defs_construct(self):
         """Every declared instrument is constructible and re-entrant
         (aliases prior storage instead of shadowing it)."""
