@@ -516,6 +516,40 @@ def _logging_suite():
         return {"error": repr(e)}
 
 
+# Profiling-plane-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): tasks/s on a CPU-
+# burning fan-out with the sampling profiler on (RMT_PROFILE=1) vs off,
+# and the overhead percentage the ISSUE caps at 5%.
+REQUIRED_PROFILE_FIELDS = (
+    "profile_on_tasks_per_s", "profile_off_tasks_per_s",
+    "profile_overhead_pct", "n_tasks", "trials",
+)
+
+
+def _profile_suite():
+    """Profiling-plane overhead (utils/profile_bench.py); fault-isolated
+    so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.profile_bench import (
+            run_profile_suite,
+        )
+
+        out = run_profile_suite()
+        print(
+            f"  profile fan-out ({out['n_tasks']} CPU-burn tasks): "
+            f"{out['profile_on_tasks_per_s']:.0f} tasks/s on vs "
+            f"{out['profile_off_tasks_per_s']:.0f} off "
+            f"({out['profile_overhead_pct']:+.1f}% overhead)",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_PROFILE_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  profile suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 # Elastic-training contract surfaced in BENCH_DETAIL.json
 # (tests/test_bench_format.py enforces the set): steps/s with durability
 # off/sync/async, the step-blocking slice of one save in each mode (the
@@ -679,6 +713,7 @@ def main() -> None:
     device = _device_suite()
     tracing = _tracing_suite()
     logging_out = _logging_suite()
+    profile = _profile_suite()
     elastic = _elastic_suite()
     scale = _scale_suite()
     tpu = _tpu_suite()
@@ -691,7 +726,7 @@ def main() -> None:
               "transfer": transfer, "compression": compression,
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
-              "elastic": elastic,
+              "profile": profile, "elastic": elastic,
               "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -703,20 +738,22 @@ def main() -> None:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "tpu", "transfer",
                     "compression", "locality", "device",
-                    "tracing", "logging", "elastic", "metrics"):
+                    "tracing", "logging", "profile", "elastic",
+                    "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
 
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
-                        compression, logging=logging_out, device=device))
+                        compression, logging=logging_out, device=device,
+                        profile=profile))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
-                  device=None):
+                  device=None, profile=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -781,6 +818,12 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
         line["logging"] = {
             "overhead_pct": logging["logging_overhead_pct"],
         }
+    if profile and "error" not in profile:
+        # the profiling-plane acceptance number: CPU-burn fan-out
+        # overhead with the sampler on everywhere (<=5%)
+        line["profile"] = {
+            "overhead_pct": profile["profile_overhead_pct"],
+        }
     if compression and "error" not in compression:
         # the compressed-plane acceptance numbers: best-corpus speedup of
         # effective over the same-run uncompressed control, the chain's
@@ -831,8 +874,9 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("compression", "elastic", "logging", "tracing",
-                  "device", "locality", "transfer", "micro", "scale"):
+        for k in ("profile", "compression", "elastic", "logging",
+                  "tracing", "device", "locality", "transfer", "micro",
+                  "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

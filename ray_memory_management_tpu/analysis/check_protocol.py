@@ -23,6 +23,15 @@ Key extraction (core/transfer.py only):
   * subscript writes/reads and ``.get("k")`` on variables named
     ``req``/``first_req`` (request side) or ``reply``/``hdr`` (reply
     side) are observed.
+
+A third side covers the observability piggyback frames (the profiling
+plane rides them): in ``core/worker.py`` and ``core/node_agent.py``,
+dict literals whose ``"type"`` is ``"profile"`` (the worker's flush
+frame) or ``"pong"`` (the agent's keepalive reply) plus subscript
+writes/``get`` reads on variables named ``frame``/``pong`` observe the
+``FRAME_KEYS`` set — same additive-only contract: the head ignores
+unknown frame keys, so adding one is safe across a rolling upgrade and
+removing one strands data old peers still send.
 """
 
 from __future__ import annotations
@@ -39,6 +48,10 @@ _SCHEMA_SUFFIX = "analysis/protocol_schema.py"
 _REQUEST_VARS = {"req", "first_req", "request"}
 _REPLY_VARS = {"reply", "hdr", "header", "resp"}
 _REPLY_MARKERS = {"size", "error", "deferred"}
+# observability piggyback frames: worker flush frame + agent pong
+_FRAME_SUFFIXES = ("core/worker.py", "core/node_agent.py")
+_FRAME_VARS = {"frame", "pong"}
+_FRAME_TYPES = {"profile", "pong"}
 
 
 def observed_keys(project: Project) -> Tuple[Set[str], Set[str]]:
@@ -78,13 +91,50 @@ def observed_keys(project: Project) -> Tuple[Set[str], Set[str]]:
     return req, rep
 
 
-def schema_keys(project: Project) -> Tuple[Set[str], Set[str], str]:
-    """(request_keys, reply_keys, path) from protocol_schema.py."""
+def observed_frame_keys(project: Project) -> Set[str]:
+    """Frame keys actually sent by core/worker.py + core/node_agent.py."""
+    frame: Set[str] = set()
+    for suffix in _FRAME_SUFFIXES:
+        sf = project.get(suffix)
+        if sf is None or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Dict):
+                keys = set(dict_literal_keys(node))
+                if "type" not in keys:
+                    continue
+                for k, v in zip(node.keys, node.values):
+                    if const_str(k) == "type" and \
+                            const_str(v) in _FRAME_TYPES:
+                        frame |= keys
+                        break
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in _FRAME_VARS:
+                key = const_str(node.slice)
+                if key is not None:
+                    frame.add(key)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in _FRAME_VARS:
+                key = const_str(node.args[0])
+                if key is not None:
+                    frame.add(key)
+    return frame
+
+
+def schema_keys(project: Project
+                ) -> Tuple[Set[str], Set[str], Set[str], str]:
+    """(request_keys, reply_keys, frame_keys, path) from
+    protocol_schema.py."""
     sf = project.get(_SCHEMA_SUFFIX)
     req: Set[str] = set()
     rep: Set[str] = set()
+    frame: Set[str] = set()
     if sf is None or sf.tree is None:
-        return req, rep, ""
+        return req, rep, frame, ""
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Name) and \
@@ -95,7 +145,9 @@ def schema_keys(project: Project) -> Tuple[Set[str], Set[str], str]:
                 req = vals
             elif node.targets[0].id == "REPLY_KEYS":
                 rep = vals
-    return req, rep, sf.path
+            elif node.targets[0].id == "FRAME_KEYS":
+                frame = vals
+    return req, rep, frame, sf.path
 
 
 _HEADER = '''"""Generated wire-protocol v2 key registry — do not hand-edit key sets.
@@ -111,7 +163,8 @@ diff lands in the same commit as the protocol change.
 '''
 
 
-def _regenerate(path: str, req: Set[str], rep: Set[str]) -> None:
+def _regenerate(path: str, req: Set[str], rep: Set[str],
+                frame: Set[str]) -> None:
     def block(name: str, comment: str, keys: Set[str]) -> str:
         lines = [f"# {comment}", f"{name} = ("]
         lines += [f"    \"{k}\"," for k in sorted(keys)]
@@ -125,6 +178,10 @@ def _regenerate(path: str, req: Set[str], rep: Set[str]) -> None:
             + "\n\n"
             + block("REPLY_KEYS",
                     "v2 fetch reply: server -> client header dict", rep)
+            + "\n\n"
+            + block("FRAME_KEYS",
+                    "observability piggyback frames: worker flush frame "
+                    "+ agent pong", frame)
             + "\n")
     with open(path, "w", encoding="utf-8") as f:
         f.write(text)
@@ -135,26 +192,42 @@ def check_protocol_additivity(project: Project, options: dict
                               ) -> List[Violation]:
     out: List[Violation] = []
     obs_req, obs_rep = observed_keys(project)
-    sch_req, sch_rep, schema_path = schema_keys(project)
+    obs_frame = observed_frame_keys(project)
+    sch_req, sch_rep, sch_frame, schema_path = schema_keys(project)
     if not schema_path:
         out.append(Violation(
             "protocol-additivity", _SCHEMA_SUFFIX, 1,
             "analysis/protocol_schema.py missing or unparseable"))
         return out
-    if not obs_req and not obs_rep:
-        # transfer.py absent (e.g. fixture-only project): nothing to do
+    if not obs_req and not obs_rep and not obs_frame:
+        # sender files absent (e.g. fixture-only project): nothing to do
         return out
-    transfer_rel = project.get(_TRANSFER_SUFFIX).rel
     schema_rel = os.path.relpath(schema_path, project.repo_root)
 
-    for side, sch, obs in (("request", sch_req, obs_req),
-                           ("reply", sch_rep, obs_rep)):
+    # a side only votes when its sender file(s) are present and emit
+    # keys — a fixture project without transfer.py must not see its
+    # whole REQUEST_KEYS registry as "removed"
+    sides: List[Tuple[str, Set[str], Set[str], str, str]] = []
+    if obs_req or obs_rep:
+        transfer_rel = project.get(_TRANSFER_SUFFIX).rel
+        sides.append(("request", sch_req, obs_req, transfer_rel,
+                      "transfer.py"))
+        sides.append(("reply", sch_rep, obs_rep, transfer_rel,
+                      "transfer.py"))
+    if obs_frame:
+        frame_sf = next((project.get(s) for s in _FRAME_SUFFIXES
+                         if project.get(s) is not None), None)
+        frame_rel = frame_sf.rel if frame_sf else _FRAME_SUFFIXES[0]
+        sides.append(("frame", sch_frame, obs_frame, frame_rel,
+                      "worker.py/node_agent.py"))
+
+    for side, sch, obs, sender_rel, sender in sides:
         for key in sorted(sch - obs):
             out.append(Violation(
-                "protocol-additivity", transfer_rel, 1,
+                "protocol-additivity", sender_rel, 1,
                 f"wire {side} key {key!r} is registered in "
                 f"protocol_schema.py but no longer sent/read by "
-                f"transfer.py — removing or renaming a v2 key breaks "
+                f"{sender} — removing or renaming a v2 key breaks "
                 f"rolling upgrades (additive-only protocol)"))
         added = sorted(obs - sch)
         if not added:
@@ -171,6 +244,7 @@ def check_protocol_additivity(project: Project, options: dict
                 f"+ {side} key {key!r}" for key in added)
 
     if not options.get("frozen") and \
-            ((obs_req - sch_req) or (obs_rep - sch_rep)):
-        _regenerate(schema_path, sch_req | obs_req, sch_rep | obs_rep)
+            any(obs - sch for _, sch, obs, _, _ in sides):
+        _regenerate(schema_path, sch_req | obs_req, sch_rep | obs_rep,
+                    sch_frame | obs_frame)
     return out

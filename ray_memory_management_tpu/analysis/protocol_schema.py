@@ -29,3 +29,13 @@ REPLY_KEYS = (
     "size",
     "total",
 )
+
+# observability piggyback frames: worker flush frame + agent pong
+FRAME_KEYS = (
+    "events",
+    "logs",
+    "profile",
+    "samples",
+    "series",
+    "type",
+)

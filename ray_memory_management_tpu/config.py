@@ -261,6 +261,19 @@ _flag("memory_usage_threshold", float, 0.95,
 _flag("event_stats", bool, True,
       "Collect per-handler event-loop stats (src/ray/common/event_stats.cc).")
 
+# --- observability: profiling plane ------------------------------------------
+_flag("profile_hz", float, 11.0,
+      "Continuous wall-clock stack-sampling rate (samples/s) for the "
+      "profiling plane's always-on sampler in every process (worker, "
+      "agent, head). Low by design: the acceptance contract is <= 5% "
+      "tasks/s overhead on the chatty fan-out. 0 disables the continuous "
+      "sampler (burst capture stays available); RMT_PROFILE=0 disables "
+      "the whole plane.")
+_flag("profile_burst_hz", float, 97.0,
+      "Sampling rate for on-demand burst captures (rmt profile --hz "
+      "default, and the RMT_WORKER_PROFILE deprecation alias). Bursts "
+      "are short and opt-in, so this trades overhead for resolution.")
+
 
 def _coerce(typ, raw: str):
     if typ is bool:

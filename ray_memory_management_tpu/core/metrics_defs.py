@@ -249,6 +249,29 @@ DEFS: Dict[str, tuple] = {
         description="Worker-side log batch drain time per flush frame "
                     "(done reply, ticker, or exit flush).",
         boundaries=LATENCY_BOUNDARIES)),
+    # profiling plane (utils/profiler.py)
+    "rmt_proc_cpu_seconds_total": (Counter, dict(
+        description="Process CPU seconds (user+system) accumulated, by "
+                    "process role; fed by the continuous sampler's "
+                    "per-tick delta and by per-task rusage attribution.",
+        tag_keys=("role",))),
+    "rmt_proc_rss_bytes": (Gauge, dict(
+        description="Process resident set size in bytes, sampled by the "
+                    "profiling plane (/proc/self/statm; getrusage peak "
+                    "where /proc is absent).")),
+    "rmt_profile_samples_total": (Counter, dict(
+        description="Stack samples captured (one per thread per sampler "
+                    "tick or burst tick), counted in whichever process "
+                    "captured them.")),
+    "rmt_profile_bytes_total": (Counter, dict(
+        description="Folded-stack payload bytes drained onto flush "
+                    "frames / pongs (the profiling plane's wire cost).")),
+    "rmt_profile_dropped_total": (Counter, dict(
+        description="Stack samples dropped: agg_full is the bounded "
+                    "per-process aggregation map refusing a new distinct "
+                    "stack, retention is head-side ProfileStore ring "
+                    "eviction.",
+        tag_keys=("reason",))),
 }
 
 
@@ -492,3 +515,23 @@ def logs_dropped() -> Counter:
 
 def logs_flush_seconds() -> Histogram:
     return get("rmt_logs_flush_seconds")
+
+
+def proc_cpu_seconds() -> Counter:
+    return get("rmt_proc_cpu_seconds_total")
+
+
+def proc_rss_bytes() -> Gauge:
+    return get("rmt_proc_rss_bytes")
+
+
+def profile_samples() -> Counter:
+    return get("rmt_profile_samples_total")
+
+
+def profile_bytes() -> Counter:
+    return get("rmt_profile_bytes_total")
+
+
+def profile_dropped() -> Counter:
+    return get("rmt_profile_dropped_total")

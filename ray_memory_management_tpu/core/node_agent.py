@@ -115,6 +115,12 @@ class NodeAgent:
 
         _structlog.configure(node_id=self.node_id.hex(), role="agent")
         _structlog.install_logging_capture()
+        # continuous stack sampling of the agent process (transfer serves,
+        # spill IO); samples ship on the ping/pong piggyback below
+        from ..utils import profiler as _profiler
+
+        _profiler.configure(node_id=self.node_id.hex(), role="agent")
+        _profiler.start_sampler()
 
         _reap_stale_agent_stores()
         self.store_name = f"/rmtA_{os.getpid()}_{os.urandom(4).hex()}"
@@ -760,6 +766,7 @@ class NodeAgent:
                     pass
             elif t == "ping":
                 from ..utils import events as _events
+                from ..utils import profiler as _profiler
                 from ..utils import structlog as _structlog
                 from ..utils import timeline as _timeline
 
@@ -770,6 +777,7 @@ class NodeAgent:
                 # it agent-side spans never reach the head's dump
                 prof = _timeline.drain_events_if_due(min_batch=1)
                 lgs = _structlog.drain_records()
+                smp = _profiler.drain_samples()
                 pong: Dict[str, Any] = {"type": "pong"}
                 if evs:
                     pong["events"] = evs
@@ -777,6 +785,8 @@ class NodeAgent:
                     pong["profile"] = prof
                 if lgs:
                     pong["logs"] = lgs
+                if smp:
+                    pong["samples"] = smp
                 try:
                     self._send(pong)
                 except (OSError, BrokenPipeError):
@@ -786,6 +796,8 @@ class NodeAgent:
                         _timeline.ingest_events(prof)
                     if lgs:
                         _structlog.reingest(lgs)
+                    if smp:
+                        _profiler.reingest(smp)
                     return
             elif t == "shutdown":
                 return

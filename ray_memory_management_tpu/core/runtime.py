@@ -318,7 +318,7 @@ def emit_lifecycle_spans(name: str, task_id: bytes, trace_ctx,
 
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "state", "payload",
-                 "args_released", "gc_returns", "ts")
+                 "args_released", "gc_returns", "ts", "rusage")
 
     def __init__(self, spec: TaskSpec, payload: dict, retries_left: int,
                  gc_returns: bool = True):
@@ -329,6 +329,9 @@ class _TaskRecord:
         # state-transition stamps (time.time()); worker-side RUNNING /
         # WORKER_DONE merge in from the done reply's piggybacked tstamps
         self.ts: Dict[str, float] = {"SUBMITTED": time.time()}
+        # worker-side resource deltas (cpu_s, peak_rss, hbm_bytes) merged
+        # from the done reply's piggybacked rusage, like ts above
+        self.rusage: Optional[Dict[str, float]] = None
         # the task holds a reference on each of its ref args until it
         # reaches a terminal state (reference_count.h task-argument refs);
         # this flag makes the release idempotent across the several
@@ -456,6 +459,18 @@ class Runtime:
         _structlog.configure(role="driver")
         _structlog.install_logging_capture()
         _structlog.attach_store(self.log_store)
+        # profiling plane: head-side store over every process's stack
+        # samples (worker flush frames, agent pongs, and this process's
+        # own continuous sampler via the direct attach)
+        from ..utils import profiler as _profiler
+
+        self.profile_store = _profiler.ProfileStore()
+        _profiler.configure(role="driver")
+        _profiler.attach_store(self.profile_store)
+        _profiler.start_sampler(hz=float(config.profile_hz))
+        # bounded per-resource samples from finished tasks' rusage deltas
+        # (state.summarize_task_latencies resource percentiles)
+        self.task_resources: Dict[str, deque] = {}
         # hot-path instruments hoisted once (accessor calls touch the
         # registry lock)
         self._m_submitted = mdefs.tasks_submitted()
@@ -859,9 +874,11 @@ class Runtime:
             # the head's dump covers every process
             events.ingest(msg.get("events") or [])
             timeline.ingest_events(msg.get("profile") or [])
+            from ..utils import profiler as _profiler
             from ..utils import structlog as _structlog
 
             _structlog.ingest(msg.get("logs"))
+            _profiler.ingest(msg.get("samples"))
 
     def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
         from .remote_node import VirtualConn
@@ -1184,6 +1201,10 @@ class Runtime:
                 from ..utils import metrics as _metrics
 
                 _metrics.merge_series(msg["series"])
+            if msg.get("samples"):
+                from ..utils import profiler as _profiler
+
+                _profiler.ingest(msg["samples"])
         elif mtype == "pong":
             pass
         else:
@@ -2057,11 +2078,14 @@ class Runtime:
         completion side's dominant cost at high task rates."""
         profile: List[dict] = []
         logs: List[dict] = []
+        samples: List[dict] = []
         for m in msgs:
             if m.get("profile"):
                 profile.extend(m["profile"])
             if m.get("logs"):
                 logs.extend(m["logs"])
+            if m.get("samples"):
+                samples.extend(m["samples"])
         if profile:
             timeline.ingest_events(profile)
         if logs:
@@ -2070,6 +2094,12 @@ class Runtime:
             from ..utils import structlog as _structlog
 
             _structlog.ingest(logs)
+        if samples:
+            # same contract as logs: the burner's stacks are queryable
+            # (state.get_profile) the moment its get() returns
+            from ..utils import profiler as _profiler
+
+            _profiler.ingest(samples)
         nm = self.nodes.get(handle.node_id)
         for m in msgs:
             # borrowed-ref tables ride every done reply (success or not)
@@ -2111,6 +2141,7 @@ class Runtime:
         to_free: List[bytes] = []
         done_t = time.time()  # one stamp for the whole burst
         stage_durs: List[Dict[str, float]] = []
+        rusage_list: List[Dict[str, float]] = []
         # head-side lifecycle spans: collected under the lock, emitted
         # outside it (record_event takes the timeline lock)
         trace_spans: Optional[List[tuple]] = \
@@ -2143,6 +2174,10 @@ class Runtime:
                     if wt:
                         rec.ts.update(wt)
                     rec.ts["FINISHED"] = done_t
+                    ru = m.get("rusage")
+                    if ru:
+                        rec.rusage = ru
+                        rusage_list.append(ru)
                     stage_durs.append(stage_durations(rec.ts))
                     if trace_spans is not None:
                         trace_spans.append(
@@ -2177,6 +2212,8 @@ class Runtime:
                 emit_lifecycle_spans(name, tid_, tctx, ts)
         if stage_durs:
             self._record_task_latencies(stage_durs)
+        if rusage_list:
+            self._record_task_resources(rusage_list)
         self.free_objects(to_free)
         if nudge:
             self._wakeup()
@@ -2195,6 +2232,22 @@ class Runtime:
                     buf = lat[stage] = deque(maxlen=4096)
                 buf.append(d)
                 hist.observe(d, tags={"stage": stage})
+
+    def _record_task_resources(self,
+                               rusage_list: List[Dict[str, float]]) -> None:
+        """Fold finished tasks' rusage deltas into bounded per-resource
+        percentile buffers (state.summarize_task_latencies resources
+        section), the attribution analog of _record_task_latencies."""
+        res = self.task_resources
+        for ru in rusage_list:
+            for key in ("cpu_s", "peak_rss", "hbm_bytes"):
+                v = ru.get(key)
+                if v is None:
+                    continue
+                buf = res.get(key)
+                if buf is None:
+                    buf = res[key] = deque(maxlen=4096)
+                buf.append(float(v))
 
     # --------------------------------------------------------------- actors
     def create_actor(self, payload: dict) -> bytes:
@@ -3544,7 +3597,7 @@ class Runtime:
                 self.task_history.append(
                     (tid, rec.spec.name, rec.state, rec.spec.num_returns,
                      rec.retries_left, rec.spec.is_actor_task, rec.ts,
-                     rec.spec.trace_ctx))
+                     rec.spec.trace_ctx, rec.rusage))
                 del self.tasks[tid]
                 for a in self._ref_deps(rec.spec):
                     n = self._lineage_dependents.get(a, 0) - 1
@@ -3910,6 +3963,15 @@ class Runtime:
             from ..utils import structlog as _structlog
 
             _structlog.attach_store(None)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            # same for the ProfileStore; the continuous sampler stops
+            # with the cluster (a later init restarts it)
+            from ..utils import profiler as _profiler
+
+            _profiler.stop_sampler()
+            _profiler.attach_store(None)
         except Exception:  # noqa: BLE001
             pass
         try:

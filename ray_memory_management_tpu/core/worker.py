@@ -36,7 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from .. import serialization as ser
-from ..utils import faults, structlog, tracing
+from ..utils import faults, profiler, structlog, tracing
 from .object_store import StoreClient
 
 log = structlog.get_logger(__name__)
@@ -787,6 +787,12 @@ class Worker:
         # log records emitted by the task body (print, logging, package
         # logger) attribute to this task via the same ContextVar pattern
         log_tok = structlog.set_task_context(task_id.hex())
+        # the stack sampler reads task identity through a per-thread-ident
+        # map (ContextVars are invisible across threads); register it at
+        # the same boundary, and bracket execution with rusage snapshots
+        prof_tok = profiler.set_task_context(
+            task_id.hex(), trace_ctx[0] if trace_ctx else None)
+        ru0 = profiler.task_rusage_begin(self.device_store)
         try:
             self._apply_chip_lease(msg)
             fn = self._resolve_function(msg)
@@ -821,6 +827,12 @@ class Worker:
         finally:
             tracing.reset(trace_tok)
             structlog.reset_task_context(log_tok)
+            profiler.reset_task_context(prof_tok)
+            # resource deltas ride the reply like tstamps; computed here,
+            # before the frame's refs drop, so peak_rss sees the task's
+            # working set
+            reply["rusage"] = profiler.task_rusage_end(
+                ru0, self.device_store)
             for oid in pinned:
                 self.store.release(oid)
         # drop the frame's refs BEFORE computing the borrow table: only
@@ -837,6 +849,12 @@ class Worker:
         lgs = structlog.drain_records()
         if lgs:
             reply["logs"] = lgs
+        # same contract for stack samples: the head ingests them before
+        # resolving the future, so the burner's frames are queryable
+        # through get_profile the moment get() returns
+        smp = profiler.drain_samples()
+        if smp:
+            reply["samples"] = smp
         # worker-side lifecycle stamps ride the reply; the owner merges
         # them into the task's transition record (task_events analog)
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
@@ -997,6 +1015,9 @@ class Worker:
         trace_tok = tracing.set_current(trace_ctx)
         log_tok = structlog.set_task_context(task_id.hex(),
                                             msg["actor_id"].hex())
+        prof_tok = profiler.set_task_context(
+            task_id.hex(), trace_ctx[0] if trace_ctx else None)
+        ru0 = profiler.task_rusage_begin(self.device_store)
         try:
             args, kwargs, pinned = self.decode_args(msg["args"], msg["kwargs"])
             if inspect.iscoroutinefunction(method):
@@ -1018,17 +1039,23 @@ class Worker:
                     # the method body to chain
                     tok = tracing.set_current(tc)
                     ltok = structlog.set_task_context(tid.hex(), aid.hex())
+                    # the loop thread runs this coroutine — register the
+                    # task identity there so samples taken mid-await
+                    # attribute correctly (per-thread map, see exec_task)
+                    ptok = profiler.set_task_context(
+                        tid.hex(), tc[0] if tc else None)
                     try:
                         async with s.async_sem:
                             return await m(*a, **kw)
                     finally:
                         tracing.reset(tok)
                         structlog.reset_task_context(ltok)
+                        profiler.reset_task_context(ptok)
 
                 fut = asyncio.run_coroutine_threadsafe(_bounded(), loop)
                 fut.add_done_callback(
                     lambda f, p=pinned: self._finish_actor_task(
-                        msg, t0, p, f)
+                        msg, t0, p, f, ru0)
                 )
                 return
             result = method(*args, **kwargs)
@@ -1044,6 +1071,8 @@ class Worker:
         finally:
             tracing.reset(trace_tok)
             structlog.reset_task_context(log_tok)
+            profiler.reset_task_context(prof_tok)
+        reply["rusage"] = profiler.task_rusage_end(ru0, self.device_store)
         for oid in pinned:
             self.store.release(oid)
         # only refs retained in actor/user state survive this drop and
@@ -1055,13 +1084,16 @@ class Worker:
         lgs = structlog.drain_records()
         if lgs:
             reply["logs"] = lgs
+        smp = profiler.drain_samples()
+        if smp:
+            reply["samples"] = smp
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
 
     def _finish_actor_task(self, msg: dict, t0: float, pinned: List[bytes],
-                           fut) -> None:
+                           fut, ru0: Optional[dict] = None) -> None:
         """Completion callback for async actor methods (runs on the actor's
         loop thread when the coroutine finishes)."""
         task_id = msg["task_id"]
@@ -1095,7 +1127,16 @@ class Worker:
         lgs = structlog.drain_records()
         if lgs:
             reply["logs"] = lgs
+        smp = profiler.drain_samples()
+        if smp:
+            reply["samples"] = smp
         reply["tstamps"] = {"RUNNING": t0, "WORKER_DONE": time.time()}
+        if ru0 is not None:
+            # begin was snapped on the dispatcher thread, end runs here on
+            # the loop thread — task_rusage_end detects the mismatch and
+            # falls back to the process CPU clock
+            reply["rusage"] = profiler.task_rusage_end(
+                ru0, self.device_store)
         _inc_executed()
         reply.update(self.proxy.ref_tables())  # borrows/releases ride along
         self.sender.send(reply)
@@ -1147,7 +1188,11 @@ class Worker:
             series = _metrics.snapshot_deltas()
         except Exception:  # noqa: BLE001 — never block the flush on stats
             series = []
-        if not (spans or evs or lgs or series):
+        try:
+            smp = profiler.drain_samples()
+        except Exception:  # noqa: BLE001 — never block the flush on stats
+            smp = []
+        if not (spans or evs or lgs or series or smp):
             return None
         frame: dict = {"type": "profile", "profile": spans or []}
         if evs:
@@ -1156,6 +1201,8 @@ class Worker:
             frame["logs"] = lgs
         if series:
             frame["series"] = series
+        if smp:
+            frame["samples"] = smp
         return frame
 
     def _profile_flush_loop(self) -> None:
@@ -1208,6 +1255,10 @@ class Worker:
         # minting attributed records for the head LogStore
         structlog.configure(node_id=self.node_id.hex(), role="worker")
         structlog.install_worker_capture()
+        # continuous low-hz stack sampling for the profiling plane; the
+        # drained samples ride the same flush frames as spans/logs
+        profiler.configure(node_id=self.node_id.hex(), role="worker")
+        profiler.start_sampler()
         threading.Thread(target=self._profile_flush_loop, daemon=True,
                          name="profile-flush").start()
         # registration doubles as the ready signal (exec-then-connect

@@ -64,13 +64,24 @@ def main() -> None:
     if _bootstrap is not None:
         w.bootstrap_msg = _bootstrap
     if os.environ.get("RMT_WORKER_PROFILE"):
-        import cProfile
-        import threading
+        # deprecation alias for the retired cProfile hook: a burst
+        # capture from the sampling profiler, dumping folded stacks to
+        # the old per-pid path (plus shipping them over the wire like
+        # any other samples)
+        import warnings
 
-        pr = cProfile.Profile()
-        pr.enable()
+        from ..utils import profiler
+
+        # FutureWarning: visible under the default filters (plain
+        # DeprecationWarning is silenced outside __main__, and this
+        # must reach the operator who set the env var)
+        warnings.warn(
+            "RMT_WORKER_PROFILE is deprecated: the cProfile hook was "
+            "replaced by the sampling profiler (rmt profile / "
+            "state.get_profile); this run takes a 2s burst capture "
+            "instead", FutureWarning, stacklevel=1)
         path = os.environ["RMT_WORKER_PROFILE"] + f".{os.getpid()}"
-        threading.Timer(2.0, lambda: pr.dump_stats(path)).start()
+        profiler.start_burst(2.0, path=path)
     w.run()
 
 

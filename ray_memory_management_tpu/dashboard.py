@@ -21,6 +21,9 @@ Routes::
     /api/logs               structured log records + dropped count;
                             filters: ?task_id=&trace_id=&node_id=
                             &level=&since=&limit= (400 on bad params)
+    /api/profile            folded stack samples + dropped count;
+                            filters: ?task_id=&trace_id=&node_id=
+                            &since=&limit=&fold= (400 on bad params)
     /metrics                Prometheus exposition text
 """
 
@@ -206,6 +209,44 @@ class Dashboard:
                 # + store retention evictions): non-zero warns the view
                 # is a suffix — mirrors /api/timeline
                 "dropped": _structlog.dropped_count(),
+            }
+        elif path == "/api/profile":
+            from .utils import profiler as _profiler
+
+            limit = 10000
+            if "limit" in query:
+                try:
+                    limit = int(query["limit"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "limit must be an integer"}')
+                if limit < 0:
+                    return (400, "application/json",
+                            b'{"error": "limit must be >= 0"}')
+            since = None
+            if "since" in query:
+                try:
+                    since = float(query["since"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "since must be a timestamp"}')
+            fold = True
+            if "fold" in query:
+                raw = query["fold"].lower()
+                if raw not in ("0", "1", "true", "false"):
+                    return (400, "application/json",
+                            b'{"error": "fold must be 0/1/true/false"}')
+                fold = raw in ("1", "true")
+            data = {
+                "profile": state.get_profile(
+                    task_id=query.get("task_id"),
+                    trace_id=query.get("trace_id"),
+                    node_id=query.get("node_id"),
+                    since=since, limit=limit, fold=fold),
+                # drops since start (sampler aggregation overflow seen
+                # locally + store retention evictions): non-zero warns
+                # the view is a suffix — mirrors /api/logs
+                "dropped": _profiler.dropped_count(),
             }
         else:
             return 404, "application/json", b'{"error": "not found"}'

@@ -88,7 +88,16 @@ def cmd_status(args) -> int:
 def cmd_check(args) -> int:
     """Static-analysis suite (lock discipline, metric/fault registry
     consistency, wire-protocol additivity, trace propagation). Exits
-    non-zero with ``file:line: rule: message`` output on violations."""
+    non-zero with ``file:line: rule: message`` output on violations.
+    ``--perf`` instead runs the perf-regression gate: the newest bench
+    round's headline fields diffed against the previous round with
+    per-field tolerance bands."""
+    if args.perf:
+        from ray_memory_management_tpu.analysis import check_perf
+
+        return check_perf.main(
+            root=args.root, baseline=args.baseline,
+            current=args.current, as_json=args.json)
     from ray_memory_management_tpu.analysis.__main__ import main as check
 
     argv = []
@@ -209,6 +218,45 @@ def cmd_logs(args) -> int:
             time.sleep(args.poll_interval)
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_profile(args) -> int:
+    """Folded stack samples from the cluster profiling plane (like
+    ``logs``/``trace``, reads the in-process runtime — call
+    main(['profile', ...]) from a driver). ``--duration`` waits that
+    long first so the continuous samplers accumulate more cluster-wide
+    samples (and, with ``--hz``, additionally burst-samples THIS process
+    at that rate while waiting). ``-o FILE`` writes collapsed-stack
+    lines (``stack count``) ready for flamegraph.pl / Speedscope."""
+    import time
+
+    from ray_memory_management_tpu import _worker_context, state
+    from ray_memory_management_tpu.utils import profiler
+
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['profile']))",
+              file=sys.stderr)
+        return 1
+    if args.duration:
+        if args.hz:
+            profiler.burst(args.duration, args.hz)
+        else:
+            time.sleep(args.duration)
+    folded = state.get_profile(node_id=args.node_id,
+                               task_id=args.task_id,
+                               trace_id=args.trace_id,
+                               limit=args.limit, fold=True)
+    lines = [f"{r['stack']} {r['count']}" for r in folded]
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+        print(f"{len(lines)} folded stacks written to {args.output}")
+    else:
+        for line in lines:
+            print(line)
+    return 0
 
 
 def cmd_microbenchmark(args) -> int:
@@ -365,6 +413,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--rule", action="append", dest="rules", metavar="RULE",
                    help="run only this rule (repeatable)")
     s.add_argument("--root", default=None, help="repo root to analyze")
+    s.add_argument("--perf", action="store_true",
+                   help="run the perf-regression gate over the "
+                        "BENCH_r*.json history instead of the static "
+                        "rules (exit 1 on a regression past tolerance)")
+    s.add_argument("--baseline", default=None, metavar="ROUND",
+                   help="with --perf: baseline round (e.g. 5 or "
+                        "BENCH_r05.json; default: previous parseable "
+                        "round)")
+    s.add_argument("--current", default=None, metavar="ROUND",
+                   help="with --perf: round under test (default: newest "
+                        "parseable round)")
     s.set_defaults(fn=cmd_check)
 
     s = sub.add_parser("memory", help="object store summary")
@@ -406,6 +465,30 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--poll-interval", type=float, default=0.5,
                    help="follow poll period in seconds (default 0.5)")
     s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser(
+        "profile",
+        help="query the cluster profiling plane (folded stack samples "
+             "from every process, task/trace-correlated); -o writes "
+             "flamegraph.pl-ready collapsed stacks")
+    s.add_argument("--task", dest="task_id", default=None,
+                   help="filter: task id (hex)")
+    s.add_argument("--trace", dest="trace_id", default=None,
+                   help="filter: trace id (hex)")
+    s.add_argument("--node", dest="node_id", default=None,
+                   help="filter: node id (hex)")
+    s.add_argument("--duration", type=float, default=None,
+                   help="accumulate samples for this many seconds "
+                        "before querying")
+    s.add_argument("--hz", type=float, default=None,
+                   help="with --duration: burst-sample this process at "
+                        "this rate while waiting")
+    s.add_argument("--limit", type=int, default=10000,
+                   help="newest N samples to merge (default 10000)")
+    s.add_argument("-o", "--output", default=None,
+                   help="write folded 'stack count' lines here instead "
+                        "of stdout")
+    s.set_defaults(fn=cmd_profile)
 
     s = sub.add_parser("microbenchmark",
                        help="run the core microbenchmark suite")

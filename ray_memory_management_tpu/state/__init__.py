@@ -7,6 +7,7 @@ plus the GCS global-state reads in ray._private.state.
 
 from .api import (  # noqa: F401
     get_logs,
+    get_profile,
     get_trace,
     list_actors,
     list_cluster_events,

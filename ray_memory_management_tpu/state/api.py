@@ -97,10 +97,14 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
         "trace_id": trace_ctx[0] if trace_ctx else None,
         "span_id": trace_ctx[1] if trace_ctx else None,
         "parent_span_id": trace_ctx[2] if trace_ctx else None,
-    } for tid, name, state, nret, retries, is_actor, ts, trace_ctx
+        "cpu_s": rusage.get("cpu_s") if rusage else None,
+        "peak_rss": rusage.get("peak_rss") if rusage else None,
+        "hbm_bytes": rusage.get("hbm_bytes") if rusage else None,
+    } for tid, name, state, nret, retries, is_actor, ts, trace_ctx, rusage
         in history]
     for task_id, rec in records:
         tctx = rec.spec.trace_ctx
+        ru = rec.rusage
         rows.append({
             "task_id": task_id.hex(),
             "name": rec.spec.name,
@@ -112,6 +116,9 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "trace_id": tctx[0] if tctx else None,
             "span_id": tctx[1] if tctx else None,
             "parent_span_id": tctx[2] if tctx else None,
+            "cpu_s": ru.get("cpu_s") if ru else None,
+            "peak_rss": ru.get("peak_rss") if ru else None,
+            "hbm_bytes": ru.get("hbm_bytes") if ru else None,
         })
     return _apply_filters(rows, filters)[:limit]
 
@@ -269,7 +276,7 @@ def _trace_task_rows(trace_id: str) -> List[Dict[str, Any]]:
         history = list(rt.task_history) if missing else []
     if missing:
         want = set(missing)
-        for tid, name, state, _n, _r, _a, ts, tctx in history:
+        for tid, name, state, _n, _r, _a, ts, tctx, _ru in history:
             if tid in want and tctx:
                 found[tid] = (name, state, tctx, dict(ts))
     rows = []
@@ -348,6 +355,37 @@ def get_logs(task_id: Optional[str] = None,
                        limit=limit)
 
 
+def get_profile(node_id: Optional[str] = None,
+                task_id: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                since: Optional[float] = None,
+                limit: int = 10000,
+                fold: bool = True):
+    """Query the cluster's profiling plane (utils/profiler.py): stack
+    samples every worker/agent/driver process captured, stamped with
+    node/pid/role/thread/task/trace identity. Filters are ANDed; id
+    filters take hex strings (the ids list_tasks/get_trace rows carry).
+
+    With ``fold=True`` (default) matching samples merge into collapsed
+    form: ``[{"stack": "root;child;leaf", "count": n}, ...]``, heaviest
+    first — one ``"\\n".join(f"{r['stack']} {r['count']}")`` away from
+    flamegraph.pl / Speedscope input. ``fold=False`` returns the raw
+    sample records (newest ``limit``, oldest-first)."""
+    rt = _runtime()
+    store = getattr(rt, "profile_store", None)
+    if store is None:
+        return []
+    samples = store.query(task_id=task_id, trace_id=trace_id,
+                          node_id=node_id, since=since, limit=limit)
+    if not fold:
+        return samples
+    from ..utils import profiler as _profiler
+
+    folded = _profiler.fold(samples)
+    return [{"stack": stack, "count": count} for stack, count in
+            sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))]
+
+
 # Critical-path attribution: stage -> transition-stamp intervals, listed
 # in PRIORITY order. A wall-clock instant covered by several overlapping
 # intervals (a sibling executing while another waits in queue) is charged
@@ -421,7 +459,12 @@ def summarize_task_latencies() -> Dict[str, Dict[str, float]]:
     p99, milliseconds) over the runtime's bounded stage-duration samples
     — the ``ray summary tasks`` timing breakdown analog. Exact
     percentiles from raw samples, not bucket interpolation (the
-    rmt_task_stage_seconds histogram serves the monitoring view)."""
+    rmt_task_stage_seconds histogram serves the monitoring view).
+
+    When finished tasks carried rusage deltas (the profiling plane's
+    per-task attribution), a ``resources`` stage reports cpu_s /
+    peak_rss / hbm_bytes percentiles in native units (seconds / bytes),
+    keyed ``<resource>_{count,mean,p50,p95,p99}``."""
     rt = _runtime()
     out: Dict[str, Dict[str, float]] = {}
     for stage, buf in list(rt.task_latencies.items()):
@@ -435,4 +478,18 @@ def summarize_task_latencies() -> Dict[str, Dict[str, float]]:
             "p95_ms": _percentile(vals, 0.95) * 1e3,
             "p99_ms": _percentile(vals, 0.99) * 1e3,
         }
+    resources: Dict[str, float] = {}
+    for key, buf in list(getattr(rt, "task_resources", {}).items()):
+        vals = sorted(buf)
+        if not vals:
+            continue
+        resources.update({
+            f"{key}_count": len(vals),
+            f"{key}_mean": sum(vals) / len(vals),
+            f"{key}_p50": _percentile(vals, 0.50),
+            f"{key}_p95": _percentile(vals, 0.95),
+            f"{key}_p99": _percentile(vals, 0.99),
+        })
+    if resources:
+        out["resources"] = resources
     return out

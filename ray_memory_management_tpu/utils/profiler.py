@@ -1,0 +1,636 @@
+"""Cluster profiling plane: continuous stack sampling + per-task rusage.
+
+The fourth observability pillar next to the metric registry
+(core/metrics_defs.py), the trace/timeline plane (utils/tracing.py) and
+the log plane (utils/structlog.py). Two signals feed it:
+
+- a dependency-free wall-clock **sampling stack profiler**: a daemon
+  thread wakes ``profile_hz`` times a second, snapshots every thread's
+  stack via ``sys._current_frames()`` and folds each into the collapsed
+  "root;child;leaf" form flamegraph/Speedscope tooling eats directly.
+  Samples are aggregated in-process (identical stacks collapse into one
+  counted entry between flushes), tagged with the executing task's
+  ``task_id``/``trace_id`` — ContextVars are invisible across threads,
+  so the worker registers its task identity in a per-thread-ident map
+  the sampler can read (``set_task_context`` below, installed at the
+  same sites as structlog's ContextVar);
+- **per-task resource attribution**: ``task_rusage_begin/end`` bracket
+  task execution and compute (cpu_s, peak_rss, hbm_bytes) deltas from
+  per-thread CPU clocks, ``/proc/self/statm`` and the worker's
+  device-store pinned bytes. The deltas ride the done reply like
+  ``tstamps`` and land on the task lifecycle record.
+
+Transport reuses the existing planes verbatim: worker samples ride the
+1s profile flush frame (``samples`` key, next to ``profile``/``logs``/
+``series``) and the exit-path final flush; agent samples piggyback on
+ping/pong. The head attaches a ``ProfileStore`` (ring + task/trace/node
+indices, same shape as structlog.LogStore) behind ``state.get_profile``
+/ ``/api/profile`` / ``rmt profile``. The whole plane is gated by
+``RMT_PROFILE=0`` (same contract as ``RMT_LOGS``/``RMT_TIMELINE``),
+which is what utils/profile_bench.py measures.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from . import tracing
+
+# -- enable gate (RMT_PROFILE, mirroring RMT_LOGS) ----------------------------
+
+_enabled = os.environ.get("RMT_PROFILE", "1") != "0"
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+# -- process identity + per-thread task context -------------------------------
+
+_node_id: Optional[str] = None
+_role: str = "driver"
+
+# thread ident -> (task_id_hex, trace_id). A plain dict, NOT a
+# ContextVar: the sampler reads it from ITS OWN thread, and ContextVars
+# are per-thread by construction. The worker writes it at the same four
+# sites it installs structlog's task ContextVar (exec_task, both actor
+# paths, inside async coroutines).
+_thread_ctx: Dict[int, Tuple[Optional[str], Optional[str]]] = {}
+_lock = threading.Lock()
+
+
+def configure(node_id: Optional[str] = None, role: Optional[str] = None
+              ) -> None:
+    """Stamp this process's identity onto every subsequent sample."""
+    global _node_id, _role
+    if node_id is not None:
+        _node_id = node_id
+    if role is not None:
+        _role = role
+
+
+def set_task_context(task_id: Optional[str],
+                     trace_id: Optional[str] = None):
+    """Register the calling thread's executing-task identity for the
+    sampler; returns a reset token for ``reset_task_context``."""
+    ident = threading.get_ident()
+    with _lock:
+        prev = _thread_ctx.get(ident)
+        if task_id:
+            _thread_ctx[ident] = (task_id, trace_id)
+        else:
+            _thread_ctx.pop(ident, None)
+    return (ident, prev)
+
+
+def reset_task_context(token) -> None:
+    try:
+        ident, prev = token
+    except Exception:  # noqa: BLE001 — foreign token
+        return
+    with _lock:
+        if prev is None:
+            _thread_ctx.pop(ident, None)
+        else:
+            _thread_ctx[ident] = prev
+
+
+def current_task_context(ident: Optional[int] = None
+                         ) -> Tuple[Optional[str], Optional[str]]:
+    """(task_id, trace_id) the sampler would stamp for a thread. Falls
+    back to the tracing ContextVar when called from the thread itself
+    (driver-side spans have a trace but no worker task registration)."""
+    with _lock:
+        ctx = _thread_ctx.get(
+            threading.get_ident() if ident is None else ident)
+    if ctx is not None:
+        return ctx
+    if ident is None or ident == threading.get_ident():
+        trace = tracing.get_current()
+        if trace:
+            return (None, trace[0])
+    return (None, None)
+
+
+# -- sample aggregation + process-local buffer --------------------------------
+
+# distinct (thread, task, trace, stack) entries held between flushes; a
+# pathological stack churner must not balloon worker memory. Overflow
+# drops the NEW sample (established hot stacks keep counting) with
+# reason-tagged accounting, mirroring structlog's buffer discipline.
+MAX_AGG = 4096
+# reingested/ingested whole records awaiting a store or the next flush
+MAX_BUFFER = 10_000
+_MAX_DEPTH = 64  # frames kept per stack (leafward; deep recursion truncates)
+
+# (thread_name, task_id, trace_id, stack) -> [count, last_ts]
+_agg: Dict[Tuple, List] = {}  # guarded-by: _lock
+_buffer: deque = deque()  # guarded-by: _lock
+_store: Optional["ProfileStore"] = None  # head-side direct attach
+_buf_dropped = 0  # guarded-by: _lock
+
+_m_samples = None
+_m_bytes = None
+_m_dropped = None
+
+
+def _instruments():
+    global _m_samples, _m_bytes, _m_dropped
+    if _m_samples is None:
+        from ..core import metrics_defs as mdefs
+
+        _m_samples = mdefs.profile_samples()
+        _m_bytes = mdefs.profile_bytes()
+        _m_dropped = mdefs.profile_dropped()
+    return _m_samples, _m_bytes, _m_dropped
+
+
+def fold_frame(frame) -> str:
+    """One thread stack -> collapsed form, root-first, ';'-separated.
+    Frame names are ``file.py:func`` — compact, and stable across
+    processes (no absolute paths in the folded output)."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < _MAX_DEPTH:
+        code = f.f_code
+        parts.append(
+            f"{os.path.basename(code.co_filename)}:{code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+def record_sample(thread_name: str, ident: int, frame,
+                  ts: Optional[float] = None) -> None:
+    """Fold one captured frame into the aggregation map."""
+    if not _enabled:
+        return
+    stack = fold_frame(frame)
+    if not stack:
+        return
+    ctx = current_task_context(ident)
+    key = (thread_name, ctx[0], ctx[1], stack)
+    try:
+        m_smp, _m_b, m_drop = _instruments()
+        m_smp.inc()
+    except Exception:  # noqa: BLE001 — stats must never block sampling
+        m_drop = None
+    with _lock:
+        entry = _agg.get(key)
+        if entry is not None:
+            entry[0] += 1
+            entry[1] = ts if ts is not None else time.time()
+            return
+        if len(_agg) >= MAX_AGG:
+            global _buf_dropped
+            _buf_dropped += 1
+            if m_drop is not None:
+                try:
+                    m_drop.inc(tags={"reason": "agg_full"})
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        _agg[key] = [1, ts if ts is not None else time.time()]
+
+
+def sample_once(skip_idents: Iterable[int] = ()) -> int:
+    """Capture every live thread's stack once (the sampler tick body;
+    also the burst loop's). Returns the number of stacks captured."""
+    if not _enabled:
+        return 0
+    skip = set(skip_idents)
+    skip.add(threading.get_ident())
+    names = {t.ident: t.name for t in threading.enumerate()}
+    n = 0
+    for ident, frame in sys._current_frames().items():
+        if ident in skip:
+            continue
+        record_sample(names.get(ident, f"thread-{ident}"), ident, frame)
+        n += 1
+    return n
+
+
+def drain_samples() -> List[dict]:
+    """Drain aggregated samples (plus any reingested records) for a
+    flush frame. Each record is a JSON-able dict; identical stacks that
+    recurred between flushes arrive as ONE record with ``count > 1``."""
+    now = time.time()
+    with _lock:
+        if not _agg and not _buffer:
+            return []
+        entries = list(_agg.items())
+        _agg.clear()
+        out = list(_buffer)
+        _buffer.clear()
+    pid = os.getpid()
+    for (thread, task_id, trace_id, stack), (count, ts) in entries:
+        out.append({
+            "ts": ts or now,
+            "node_id": _node_id,
+            "pid": pid,
+            "role": _role,
+            "thread": thread,
+            "task_id": task_id,
+            "trace_id": trace_id,
+            "stack": stack,
+            "count": count,
+        })
+    try:
+        _instruments()[1].inc(
+            sum(len(r.get("stack") or "") for r in out))
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def reingest(samples: Iterable[dict]) -> None:
+    """Put drained records back at the FRONT of the buffer (a pong send
+    failed; they retry on the next tick, oldest still dropping first)."""
+    with _lock:
+        _buffer.extendleft(reversed(list(samples)))
+        global _buf_dropped
+        while len(_buffer) > MAX_BUFFER:
+            _buffer.popleft()
+            _buf_dropped += 1
+
+
+def ingest(samples: Optional[Iterable[dict]]) -> None:
+    """Head-side ingest of sample records that arrived on a wire frame."""
+    if not samples:
+        return
+    store = _store
+    if store is not None:
+        for rec in samples:
+            if isinstance(rec, dict):
+                store.add(rec)
+        return
+    with _lock:
+        _buffer.extend(r for r in samples if isinstance(r, dict))
+        global _buf_dropped
+        while len(_buffer) > MAX_BUFFER:
+            _buffer.popleft()
+            _buf_dropped += 1
+
+
+def attach_store(store: Optional["ProfileStore"]) -> None:
+    """Bind the head process's ProfileStore: wire ingests and the head's
+    own drained samples go straight in. Pass None to detach."""
+    global _store
+    _store = store
+    if store is not None:
+        backlog = drain_samples()
+        for rec in backlog:
+            store.add(rec)
+
+
+def dropped_count() -> int:
+    """Drops visible from this process: aggregation/buffer overflow plus
+    (when the head store is attached) its retention evictions."""
+    with _lock:
+        n = _buf_dropped
+    store = _store
+    if store is not None:
+        n += store.dropped_count()
+    return n
+
+
+def clear() -> None:
+    """Test hook: reset aggregation, buffers, counters, store and the
+    thread-context registry (the sampler, if running, keeps running)."""
+    global _buf_dropped, _store
+    with _lock:
+        _agg.clear()
+        _buffer.clear()
+        _thread_ctx.clear()
+        _buf_dropped = 0
+    _store = None
+
+
+# -- continuous sampler thread ------------------------------------------------
+
+class _Sampler(threading.Thread):
+    """Daemon ticker: ``hz`` stack captures per second, plus per-tick
+    process rusage publication (rmt_proc_* series)."""
+
+    def __init__(self, hz: float):
+        super().__init__(name="rmt-profiler", daemon=True)
+        self.hz = hz
+        self.stop_event = threading.Event()
+        self._last_cpu: Optional[float] = None
+
+    def run(self) -> None:
+        interval = 1.0 / self.hz if self.hz > 0 else 1.0
+        while not self.stop_event.wait(interval):
+            if not _enabled:
+                continue
+            try:
+                sample_once(skip_idents=(self.ident,))
+                self._publish_rusage()
+            except Exception:  # noqa: BLE001 — sampling is advisory
+                pass
+
+    def _publish_rusage(self) -> None:
+        try:
+            from ..core import metrics_defs as mdefs
+
+            cpu = process_cpu_seconds()
+            if self._last_cpu is not None and cpu > self._last_cpu:
+                mdefs.proc_cpu_seconds().inc(cpu - self._last_cpu,
+                                             tags={"role": _role})
+            self._last_cpu = cpu
+            mdefs.proc_rss_bytes().set(float(rss_bytes()))
+        except Exception:  # noqa: BLE001 — gauges never fail the sampler
+            pass
+
+
+_sampler: Optional[_Sampler] = None
+
+
+def start_sampler(hz: Optional[float] = None) -> bool:
+    """Start the continuous sampler (idempotent). ``hz=None`` reads
+    ``profile_hz`` from config; hz <= 0 or RMT_PROFILE=0 is a no-op."""
+    global _sampler
+    if not _enabled:
+        return False
+    if hz is None:
+        try:
+            from ..config import global_config
+
+            hz = float(global_config().profile_hz)
+        except Exception:  # noqa: BLE001 — config import cycles in tests
+            hz = 11.0
+    if hz <= 0:
+        return False
+    if _sampler is not None and _sampler.is_alive():
+        return False
+    _sampler = _Sampler(hz)
+    _sampler.start()
+    return True
+
+
+def stop_sampler(timeout: float = 1.0) -> None:
+    global _sampler
+    s = _sampler
+    _sampler = None
+    if s is not None and s.is_alive():
+        s.stop_event.set()
+        s.join(timeout)
+
+
+def sampler_running() -> bool:
+    s = _sampler
+    return s is not None and s.is_alive()
+
+
+# -- on-demand burst capture --------------------------------------------------
+
+def burst(duration_s: float, hz: Optional[float] = None) -> int:
+    """Blocking high-rate capture in the calling thread: sample every
+    thread at ``hz`` (default ``profile_burst_hz``) for ``duration_s``.
+    Samples land in the normal aggregation pipeline (they ship on the
+    next flush like continuous ones). Returns stacks captured."""
+    if not _enabled or duration_s <= 0:
+        return 0
+    if hz is None:
+        try:
+            from ..config import global_config
+
+            hz = float(global_config().profile_burst_hz)
+        except Exception:  # noqa: BLE001
+            hz = 97.0
+    interval = 1.0 / hz if hz > 0 else 0.01
+    deadline = time.monotonic() + duration_s
+    n = 0
+    while time.monotonic() < deadline:
+        n += sample_once()
+        time.sleep(interval)
+    return n
+
+
+def start_burst(duration_s: float, hz: Optional[float] = None,
+                path: Optional[str] = None) -> threading.Thread:
+    """Background burst (the RMT_WORKER_PROFILE deprecation alias): a
+    daemon thread bursts for ``duration_s``; when ``path`` is given the
+    process's folded stacks are additionally dumped there at the end
+    (rough compat with the old cProfile dump-to-file behavior)."""
+
+    def _run() -> None:
+        burst(duration_s, hz)
+        if path:
+            with _lock:
+                entries = list(_agg.items())
+            folded: Dict[str, int] = {}
+            for (_t, _task, _trace, stack), (count, _ts) in entries:
+                folded[stack] = folded.get(stack, 0) + count
+            try:
+                with open(path, "w", encoding="utf-8") as f:
+                    for line in folded_lines(folded):
+                        f.write(line + "\n")
+            except OSError:
+                pass
+
+    t = threading.Thread(name="rmt-profiler-burst", target=_run,
+                         daemon=True)
+    t.start()
+    return t
+
+
+# -- per-task resource attribution --------------------------------------------
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def process_cpu_seconds() -> float:
+    """Whole-process CPU seconds (user+system), via os.times()."""
+    t = os.times()
+    return t.user + t.system
+
+
+def rss_bytes() -> int:
+    """Resident set size in bytes: /proc/self/statm (Linux), falling
+    back to getrusage peak-RSS where /proc is absent."""
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 — no resource module
+            return 0
+
+
+def _hbm_pinned_bytes(device_store) -> int:
+    if device_store is None:
+        return 0
+    try:
+        return int(device_store.total_bytes())
+    except Exception:  # noqa: BLE001 — store mid-shutdown
+        return 0
+
+
+def task_rusage_begin(device_store=None) -> dict:
+    """Snapshot taken as task execution starts; pass the result to
+    ``task_rusage_end``. Thread CPU clock is per-THREAD: if the end
+    snapshot happens on a different thread (async actor coroutines can
+    resume anywhere), the delta falls back to the process clock."""
+    return {
+        "thread": threading.get_ident(),
+        "tcpu": time.thread_time(),
+        "pcpu": process_cpu_seconds(),
+        "rss": rss_bytes(),
+        "hbm": _hbm_pinned_bytes(device_store),
+    }
+
+
+def task_rusage_end(begin: dict, device_store=None) -> dict:
+    """(cpu_s, peak_rss, hbm_bytes) deltas for one task execution — the
+    dict that rides ``reply["rusage"]`` next to ``tstamps``. Also feeds
+    the rmt_proc_* series so attribution and exposition agree."""
+    end_rss = rss_bytes()
+    if threading.get_ident() == begin.get("thread"):
+        cpu = time.thread_time() - begin.get("tcpu", 0.0)
+    else:
+        cpu = process_cpu_seconds() - begin.get("pcpu", 0.0)
+    out = {
+        "cpu_s": round(max(cpu, 0.0), 6),
+        "peak_rss": max(begin.get("rss", 0), end_rss),
+        "hbm_bytes": _hbm_pinned_bytes(device_store) - begin.get("hbm", 0),
+    }
+    try:
+        from ..core import metrics_defs as mdefs
+
+        if out["cpu_s"] > 0:
+            mdefs.proc_cpu_seconds().inc(out["cpu_s"],
+                                         tags={"role": _role})
+        mdefs.proc_rss_bytes().set(float(end_rss))
+    except Exception:  # noqa: BLE001 — stats must never fail the reply
+        pass
+    return out
+
+
+# -- folding helpers (flamegraph/Speedscope interchange) ----------------------
+
+def fold(samples: Iterable[dict]) -> Dict[str, int]:
+    """Merge sample records into {folded_stack: total_count} — the
+    collapsed-stack form ``flamegraph.pl`` / Speedscope import directly."""
+    out: Dict[str, int] = {}
+    for rec in samples:
+        stack = rec.get("stack")
+        if not stack:
+            continue
+        out[stack] = out.get(stack, 0) + int(rec.get("count") or 1)
+    return out
+
+
+def folded_lines(folded: Dict[str, int]) -> List[str]:
+    """'stack count' lines, heaviest first (stable tie-break on stack)."""
+    return [f"{stack} {count}" for stack, count in
+            sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))]
+
+
+# -- head-side store ----------------------------------------------------------
+
+DEFAULT_RETENTION = 100_000  # sample records kept in the ring
+_INDEX_KEY_CAP = 50_000  # distinct task/trace/node keys before eviction
+
+
+class ProfileStore:
+    """Head-side ring over the cluster's stack samples.
+
+    Same shape as structlog.LogStore: one bounded ring (samples are
+    homogeneous — no per-level retention here), secondary indices by
+    task, trace and node, lazy index pruning keyed on the monotone
+    ``seq`` still being inside the ring.
+    """
+
+    def __init__(self, retention: int = DEFAULT_RETENTION):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(retention))  # guarded-by: _lock
+        self._by_task: Dict[str, deque] = {}  # guarded-by: _lock
+        self._by_trace: Dict[str, deque] = {}  # guarded-by: _lock
+        self._by_node: Dict[str, deque] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+
+    # -- write ----------------------------------------------------------------
+    def add(self, rec: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            if self._ring.maxlen and len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+                try:
+                    _instruments()[2].inc(tags={"reason": "retention"})
+                except Exception:  # noqa: BLE001
+                    pass
+            self._ring.append(rec)
+            for index, key in ((self._by_task, rec.get("task_id")),
+                               (self._by_trace, rec.get("trace_id")),
+                               (self._by_node, rec.get("node_id"))):
+                if key:
+                    bucket = index.get(key)
+                    if bucket is None:
+                        if len(index) >= _INDEX_KEY_CAP:
+                            index.pop(next(iter(index)))
+                        bucket = index[key] = deque()
+                    bucket.append(rec)
+
+    # -- read -----------------------------------------------------------------
+    def query(self, task_id: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              node_id: Optional[str] = None,
+              since: Optional[float] = None,
+              limit: Optional[int] = 10_000) -> List[dict]:
+        """Filtered sample records, oldest-first, newest-``limit``.
+        ``since`` is an exclusive ts lower bound."""
+        with self._lock:
+            floor = self._ring[0]["seq"] if self._ring else self._seq + 1
+            if task_id:
+                cands = self._narrow(self._by_task, task_id, floor)
+            elif trace_id:
+                cands = self._narrow(self._by_trace, trace_id, floor)
+            elif node_id:
+                cands = self._narrow(self._by_node, node_id, floor)
+            else:
+                cands = list(self._ring)
+            out = [
+                r for r in cands
+                if (not task_id or r.get("task_id") == task_id)
+                and (not trace_id or r.get("trace_id") == trace_id)
+                and (not node_id or r.get("node_id") == node_id)
+                and (since is None or r.get("ts", 0.0) > since)
+            ]
+        out.sort(key=lambda r: r["seq"])
+        if limit is not None and limit >= 0:
+            # the [-0:] gotcha: limit=0 means "no samples", not "all"
+            out = out[-limit:] if limit else []
+        return out
+
+    def _narrow(self, index: Dict[str, deque], key: str,
+                floor: int) -> List[dict]:  # rmtcheck: holds=_lock
+        bucket = index.get(key)
+        if not bucket:
+            return []
+        # lazy prune: entries evicted from the ring are dead
+        while bucket and bucket[0]["seq"] < floor:
+            bucket.popleft()
+        if not bucket:
+            del index[key]
+            return []
+        return list(bucket)
+
+    def dropped_count(self) -> int:
+        with self._lock:
+            return self._dropped

@@ -261,6 +261,49 @@ def test_headline_line_carries_logging_summary(bench):
         assert line["logging"]["overhead_pct"] == 1.8
 
 
+def test_profile_suite_reports_required_fields(bench):
+    """The profiling suite must emit every field the BENCH_DETAIL.json
+    contract names (on/off tasks-per-s, overhead pct) — run a mini-sized
+    pass so CI proves the real code path, not a fixture."""
+    from ray_memory_management_tpu.utils.profile_bench import (
+        run_profile_suite,
+    )
+
+    out = run_profile_suite(n_tasks=16, trials=1)
+    missing = [k for k in bench.REQUIRED_PROFILE_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["profile_on_tasks_per_s"] > 0
+    assert out["profile_off_tasks_per_s"] > 0
+
+
+def test_headline_line_carries_profile_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    profile = {"profile_overhead_pct": 2.1}
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, profile=profile)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "profile" in line:  # may be popped only by the <1KB guard
+        assert line["profile"]["overhead_pct"] == 2.1
+
+
+def test_bench_detail_snapshot_has_profile_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the profile section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    profile = detail.get("profile")
+    if profile is None:
+        pytest.skip("snapshot predates the profile section")
+    if "error" not in profile:
+        missing = [k for k in bench.REQUIRED_PROFILE_FIELDS
+                   if k not in profile]
+        assert not missing, missing
+
+
 def test_elastic_suite_reports_required_fields(bench):
     """The elastic-training suite must emit every field the
     BENCH_DETAIL.json contract names (steps/s off/sync/async, blocking

@@ -171,6 +171,38 @@ class TestPrometheusExposition:
         assert 'rmt_logs_records_total{stream="stdout"}' in text
         assert 'rmt_logs_dropped_total{reason="buffer_full"}' in text
 
+    def test_profile_series_in_exposition(self):
+        """Golden coverage for the profiling-plane series: the per-role
+        process CPU counter, the RSS gauge, and the sample/byte/drop
+        counters must all surface in the exposition once they have
+        moved."""
+        counters = ("rmt_proc_cpu_seconds_total",
+                    "rmt_profile_samples_total",
+                    "rmt_profile_bytes_total",
+                    "rmt_profile_dropped_total")
+        for name in counters + ("rmt_proc_rss_bytes",):
+            assert name in mdefs.DEFS, name
+        mdefs.proc_cpu_seconds().inc(0.25, tags={"role": "worker"})
+        mdefs.proc_rss_bytes().set(123456.0)
+        mdefs.profile_samples().inc(11)
+        mdefs.profile_bytes().inc(2048)
+        mdefs.profile_dropped().inc(tags={"reason": "agg_full"})
+        mdefs.profile_dropped().inc(tags={"reason": "retention"})
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in counters:
+            assert f"# TYPE {name} counter" in lines, name
+            assert any(line.startswith(f"# HELP {name} ") and
+                       len(line) > len(f"# HELP {name} ")
+                       for line in lines), name
+            assert any(line.startswith(name) and
+                       float(line.rsplit(" ", 1)[1]) > 0
+                       for line in lines), name
+        assert "# TYPE rmt_proc_rss_bytes gauge" in lines
+        assert "rmt_proc_rss_bytes 123456.0" in lines
+        assert 'rmt_proc_cpu_seconds_total{role="worker"}' in text
+        assert 'rmt_profile_dropped_total{reason="agg_full"}' in text
+
     def test_device_series_in_exposition(self):
         """Golden coverage for the device-tier series: pinned-object and
         pinned-byte gauges, the eviction counter (tagged by destination
@@ -369,6 +401,11 @@ class TestAcceptanceWorkload:
             lat = state.summarize_task_latencies()
             assert len(lat) >= 3
             for stage, row in lat.items():
+                if stage == "resources":
+                    # the profiling plane's rusage columns: native units
+                    # (seconds/bytes), not stage latencies
+                    assert row["cpu_s_count"] >= 1, row
+                    continue
                 for key in ("count", "p50_ms", "p95_ms", "p99_ms"):
                     assert key in row, (stage, row)
                 assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
@@ -440,6 +477,8 @@ class TestAcceptanceWorkload:
         assert out["tasks"]["total"] >= 8
         assert set(out["latencies"]) == set(expected)
         for stage, row in expected.items():
+            if stage == "resources":  # rusage columns, no "count" key
+                continue
             assert out["latencies"][stage]["count"] == row["count"]
 
     def test_cli_summary_without_runtime_errors(self, capsys):
