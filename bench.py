@@ -7,12 +7,13 @@ Runs the same metrics as the reference's ``ray microbenchmark``
 (release/microbenchmark → ray_perf.py; published numbers in
 release/release_logs/2.0.0/microbenchmark.json, mirrored in BASELINE.md) on
 this runtime. Stdout contract: up to three ``{"detail": <section>, ...}``
-JSON lines (micro_stats / scale / tpu, also written to BENCH_DETAIL.json),
-then the LAST line is the compact (<1 KB guaranteed) headline:
+JSON lines (micro_stats / scale / scale_curve / tpu, also written to
+BENCH_DETAIL.json), then the LAST line is the compact (<1 KB guaranteed)
+headline:
 
     {"metric": ..., "value": <geomean ops-ratio>, "unit": "x_baseline",
      "vs_baseline": <same>, "hw": {...}, "micro": {...}, "scale": {...},
-     "tpu": {...north-star numbers...}}
+     "scale_curve": {...}, "tpu": {...north-star numbers...}}
 
 The driver captures only a bounded tail of stdout, so everything the round
 must prove lives in that final line (round 4's single giant line outgrew
@@ -619,6 +620,41 @@ def _scale_suite():
         return None
 
 
+REQUIRED_SCALE_CURVE_FIELDS = (
+    "nodes", "many_tasks_per_s", "many_actors_per_s",
+    "tasks_scaling_1_to_4", "actors_scaling_1_to_4",
+)
+
+
+def _scale_curve_suite():
+    """Throughput vs VIRTUAL node count (ISSUE 15): tasks/s and actors/s
+    at 1/2/4/8 in-process nodes, watching whether the decentralized
+    control plane (leaf leases + sharded directory + batched done
+    replies) lifts the curve off the head's single core. Fault-isolated
+    so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.scale_bench import (
+            run_scale_curve,
+        )
+
+        out = run_scale_curve()
+        for metric in ("many_tasks_per_s", "many_actors_per_s"):
+            pts = out.get(metric, {})
+            curve = "  ".join(f"{n}n:{pts[str(n)]:.1f}"
+                              for n in out["nodes"] if str(n) in pts)
+            print(f"  scale_curve {metric:20s} {curve}", file=sys.stderr)
+        print(f"  scale_curve tasks 1->4 scaling "
+              f"{out['tasks_scaling_1_to_4']}x, actors "
+              f"{out['actors_scaling_1_to_4']}x", file=sys.stderr)
+        missing = [k for k in REQUIRED_SCALE_CURVE_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  scale_curve suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _hw_ceiling():
     """Single-core memcpy bandwidth of THIS host. The reference's
     19.67 GB/s put_gigabytes row was measured on an m5.16xlarge-class
@@ -716,13 +752,15 @@ def main() -> None:
     profile = _profile_suite()
     elastic = _elastic_suite()
     scale = _scale_suite()
+    scale_curve = _scale_curve_suite()
     tpu = _tpu_suite()
 
     # Full detail goes to a file plus its own EARLIER stdout lines; the
     # LAST stdout line stays compact (<1 KB) so the driver's tail window
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
-    detail = {"micro_stats": stats, "scale": scale, "tpu": tpu,
+    detail = {"micro_stats": stats, "scale": scale,
+              "scale_curve": scale_curve, "tpu": tpu,
               "transfer": transfer, "compression": compression,
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
@@ -736,8 +774,8 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "tpu", "transfer",
-                    "compression", "locality", "device",
+    for section in ("micro_stats", "scale", "scale_curve", "tpu",
+                    "transfer", "compression", "locality", "device",
                     "tracing", "logging", "profile", "elastic",
                     "metrics"):
         if detail.get(section):
@@ -747,13 +785,13 @@ def main() -> None:
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
                         compression, logging=logging_out, device=device,
-                        profile=profile))
+                        profile=profile, scale_curve=scale_curve))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
-                  device=None, profile=None):
+                  device=None, profile=None, scale_curve=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -773,6 +811,14 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             k: scale[k] for k in
             ("many_actors_per_s", "many_tasks_per_s", "broadcast_gbps",
              "cross_node_gbps") if k in scale}
+    if scale_curve and "error" not in scale_curve:
+        # the decentralized-control-plane acceptance numbers: the
+        # per-node-count tasks/s points and the 1->4 node scaling factors
+        line["scale_curve"] = {
+            "tasks_per_s": scale_curve["many_tasks_per_s"],
+            "tasks_scaling_1_to_4": scale_curve["tasks_scaling_1_to_4"],
+            "actors_scaling_1_to_4": scale_curve["actors_scaling_1_to_4"],
+        }
     micro = {k: stats[k]["median"] for k in
              ("single_client_tasks_sync", "single_client_tasks_async",
               "single_client_put_gigabytes") if k in stats}
@@ -876,7 +922,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
         for k in ("profile", "compression", "elastic", "logging",
                   "tracing", "device", "locality", "transfer", "micro",
-                  "scale"):
+                  "scale_curve", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

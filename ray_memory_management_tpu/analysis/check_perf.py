@@ -48,6 +48,12 @@ FIELD_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("scale.many_pgs_per_s", "up", 0.40),
     ("scale.broadcast_gbps", "up", 0.40),
     ("scale.cross_node_gbps", "up", 0.40),
+    # decentralized-control-plane curve (ISSUE 15): per-node-count task
+    # throughput and the 1->4 virtual-node scaling factor must not
+    # quietly sink back toward the single-core plateau
+    ("scale_curve.tasks_per_s.1", "up", 0.35),
+    ("scale_curve.tasks_per_s.4", "up", 0.35),
+    ("scale_curve.tasks_scaling_1_to_4", "up", 0.25),
     ("tpu.train_tokens_per_s", "up", 0.35),
     ("tpu.train_mfu", "up", 0.35),
     ("tracing.overhead_pct", "down", 4.0),

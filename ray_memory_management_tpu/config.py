@@ -173,6 +173,55 @@ _flag("gcs_storage_path", str, "",
       "KV survive head restarts (the Redis-FT analog, "
       "redis_store_client.h:28).")
 
+# --- decentralized control plane ---------------------------------------------
+_flag("gcs_directory_shards", int, 0,
+      "Lock-striped shards for the GCS object directory (locations / "
+      "sizes / tiers) and the head's refcount tables, keyed by object id "
+      "so directory updates and free batches from different nodes never "
+      "contend on one lock (the reference shards its GCS tables the same "
+      "way, gcs_table_storage.h). 0 = auto (cpu_count, clamped to "
+      "[4, 64]).")
+_flag("leaf_lease_slots", int, 0,
+      "Execution-lease credits granted in bulk per node for LEAF tasks "
+      "(no placement group / affinity / runtime_env, <=1 CPU, no TPU): "
+      "the head places these round-robin without consulting the cluster "
+      "scheduler, and node agents dispatch them onto their own workers, "
+      "spilling back to the head router only when saturated (the raylet "
+      "two-level lease protocol, raylet_client.h:398). 0 = auto "
+      "(2x the node's CPU count); negative disables leaf leasing.")
+_flag("reply_flush_window_s", float, 0.001,
+      "Adaptive coalescing window for worker->head done replies: after "
+      "the first queued reply the drain thread waits up to this long for "
+      "more completions before writing one batch frame (flushes early on "
+      "reply_flush_max or an urgent frame). 0 restores write-asap.")
+_flag("reply_flush_max", int, 32,
+      "Flush the worker reply batch as soon as it reaches this many "
+      "frames, regardless of the adaptive window.")
+_flag("sealed_wal_max_bytes", int, 32 * 1024,
+      "With durable gcs_storage_path set, sealed object values up to "
+      "this size are written to a sealed-object WAL so a head restart "
+      "loses no sealed small objects (larger values stay recoverable "
+      "through lineage / spill as before). 0 disables the WAL.")
+
+# --- cloud storage credentials -----------------------------------------------
+_flag("cloud_storage_access_key", str, "",
+      "Access key id for the s3:// external-storage backend. Resolution "
+      "order: this flag (incl. RMT_cloud_storage_access_key), then the "
+      "AWS_ACCESS_KEY_ID environment variable, then the SDK default "
+      "chain (instance profile, ~/.aws).")
+_flag("cloud_storage_secret_key", str, "",
+      "Secret access key paired with cloud_storage_access_key.")
+_flag("cloud_storage_endpoint", str, "",
+      "Endpoint URL override for the s3:// backend (minio, GCS interop "
+      "mode). Empty uses the SDK default endpoint; also honors "
+      "AWS_ENDPOINT_URL.")
+_flag("cloud_storage_region", str, "",
+      "Region for the s3:// backend; falls back to AWS_DEFAULT_REGION "
+      "then the SDK default.")
+_flag("cloud_storage_credentials_file", str, "",
+      "Service-account JSON for the gs:// backend; falls back to "
+      "GOOGLE_APPLICATION_CREDENTIALS then the SDK default chain.")
+
 # --- fault tolerance ---------------------------------------------------------
 _flag("fault_injection_spec", str, "",
       "Deterministic fault-injection plane spec (utils/faults.py): "

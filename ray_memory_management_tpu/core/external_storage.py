@@ -152,23 +152,65 @@ class InMemoryStorage(ExternalStorage):
         return sorted(u for u in self._blobs if u.startswith(pfx))
 
 
+def resolve_cloud_credentials(config=None) -> Dict[str, Optional[str]]:
+    """Per-field credential resolution for the cloud tiers, in order:
+
+      1. the explicit Config flag (``cloud_storage_*``) — a cluster-level
+         override that wins over whatever the process environment says;
+      2. the SDK's conventional environment variable;
+      3. ``None`` — the SDK's own default chain (instance metadata,
+         ``~/.aws``, application-default credentials) takes over.
+
+    Returns every field, resolved-or-None, so callers can pass only what
+    resolved and never mask the SDK chain with empty strings."""
+
+    def pick(flag: str, env: str) -> Optional[str]:
+        v = getattr(config, flag, "") if config is not None else ""
+        if v:
+            return v
+        return os.environ.get(env) or None
+
+    return {
+        "access_key": pick("cloud_storage_access_key",
+                           "AWS_ACCESS_KEY_ID"),
+        "secret_key": pick("cloud_storage_secret_key",
+                           "AWS_SECRET_ACCESS_KEY"),
+        "endpoint": pick("cloud_storage_endpoint", "AWS_ENDPOINT_URL"),
+        "region": pick("cloud_storage_region", "AWS_DEFAULT_REGION"),
+        "credentials_file": pick("cloud_storage_credentials_file",
+                                 "GOOGLE_APPLICATION_CREDENTIALS"),
+    }
+
+
 class CloudStorage(ExternalStorage):
     """Object-storage spill tier (the reference's smart_open path, :204-230):
     one key per object under ``<scheme>://bucket/prefix``. The transport is a
     lazily-imported client (boto3 for s3://, google.cloud.storage for gs://) —
     absent SDKs raise at construction with a clear message, never at spill
-    time."""
+    time. Credentials resolve via :func:`resolve_cloud_credentials`
+    (Config flag → env var → SDK default chain)."""
 
-    def __init__(self, uri: str):
+    def __init__(self, uri: str, config=None):
         self.uri = uri.rstrip("/")
         scheme = uri.split("://", 1)[0]
+        creds = resolve_cloud_credentials(config)
         if scheme == "s3":
             try:
                 import boto3  # type: ignore
             except ImportError as e:  # pragma: no cover - sdk not in image
                 raise RuntimeError(
                     "s3:// spill storage requires boto3") from e
-            self._client = boto3.client("s3")
+            kw: Dict[str, str] = {}
+            if creds["access_key"]:
+                kw["aws_access_key_id"] = creds["access_key"]
+            if creds["secret_key"]:
+                kw["aws_secret_access_key"] = creds["secret_key"]
+            if creds["endpoint"]:
+                # MinIO / GCS-interop / on-prem S3 endpoints
+                kw["endpoint_url"] = creds["endpoint"]
+            if creds["region"]:
+                kw["region_name"] = creds["region"]
+            self._client = boto3.client("s3", **kw)
             self._kind = "s3"
         elif scheme == "gs":
             try:
@@ -177,7 +219,11 @@ class CloudStorage(ExternalStorage):
                 raise RuntimeError(
                     "gs:// spill storage requires google-cloud-storage"
                 ) from e
-            self._client = gcs.Client()
+            if creds["credentials_file"]:
+                self._client = gcs.Client.from_service_account_json(
+                    creds["credentials_file"])
+            else:
+                self._client = gcs.Client()
             self._kind = "gs"
         else:  # pragma: no cover - registry filters schemes
             raise ValueError(f"unsupported cloud scheme: {scheme}")
@@ -276,12 +322,17 @@ def register_storage_scheme(scheme: str, factory) -> None:
     _SCHEMES[scheme] = factory
 
 
-def storage_for_uri(uri: str) -> ExternalStorage:
+def storage_for_uri(uri: str, config=None) -> ExternalStorage:
     if "://" not in uri:
         return FileSystemStorage(uri)
     scheme = uri.split("://", 1)[0]
     factory = _SCHEMES.get(scheme)  # registry wins: file:// is overridable
     if factory is not None:
+        if factory is CloudStorage:
+            # built-in cloud tiers take the Config for credential
+            # resolution; registered third-party factories keep the
+            # plain factory(uri) contract
+            return factory(uri, config=config)
         return factory(uri)
     if scheme == "file":
         return FileSystemStorage(uri[len("file://"):])

@@ -59,6 +59,41 @@ class ActorRecord:
         self.death_cause: Optional[str] = None
 
 
+def resolve_directory_shards(n: int) -> int:
+    """0 = auto: one shard per core, clamped to [4, 64] (fewer shards
+    than cores re-serializes directory updates; more than 64 buys
+    nothing at this scale and bloats the per-GCS footprint)."""
+    if n > 0:
+        return n
+    import os
+
+    return max(4, min(64, os.cpu_count() or 4))
+
+
+class _DirectoryShard:
+    """One lock-striped slice of the object directory. Every table is
+    keyed by object id and an oid hashes to exactly one shard, so
+    directory updates and free batches for different objects never
+    contend on one lock. The three tables live and die together: a
+    holder-set entry always has a tier entry, and both are dropped (with
+    the size) when the last holder leaves."""
+
+    __slots__ = ("lock", "locations", "sizes", "tiers")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # object_id bytes -> set of NodeID with a sealed copy
+        self.locations: Dict[bytes, Set[NodeID]] = {}  # guarded-by: lock
+        # payload sizes alongside the directory (the reference's object
+        # directory carries object_size for exactly this reason:
+        # locality-aware leasing needs bytes, not just holder sets)
+        self.sizes: Dict[bytes, int] = {}  # guarded-by: lock
+        # storage tier per (object, node): "hbm" marks a live device copy
+        # pinned by a process on that node — visible to locality scoring
+        # but NOT host-readable; "shm" is the default host tier
+        self.tiers: Dict[bytes, Dict[NodeID, str]] = {}  # guarded-by: lock
+
+
 class Pubsub:
     """Callback-based pub/sub (the long-poll channels of src/ray/pubsub/
     collapse to direct callbacks in-process)."""
@@ -82,7 +117,7 @@ class Pubsub:
 
 
 class GCS:
-    def __init__(self, storage=None):
+    def __init__(self, storage=None, directory_shards: int = 0):
         from .gcs_storage import InMemoryGcsStorage
 
         self._lock = threading.RLock()
@@ -90,6 +125,7 @@ class GCS:
         # redis_store_client.h:28): durable backends persist the internal KV
         # and detached-actor specs across head restarts
         self.storage = storage or InMemoryGcsStorage()
+        self.durable = not isinstance(self.storage, InMemoryGcsStorage)
         self.nodes: Dict[NodeID, NodeInfo] = {}  # guarded-by: _lock
         self.actors: Dict[ActorID, ActorRecord] = {}  # guarded-by: _lock
         self.named_actors: Dict[str, ActorID] = {}  # guarded-by: _lock
@@ -98,22 +134,27 @@ class GCS:
         self.kv: Dict[str, bytes] = {  # guarded-by: _lock
             k: v for k, v in self.storage.items("kv")}
         self.pubsub = Pubsub()
-        # object directory: object_id bytes -> set of NodeID with a sealed copy
-        self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)  # guarded-by: _lock
-        # payload sizes alongside the directory (the reference's object
-        # directory carries object_size for exactly this reason:
-        # locality-aware leasing needs bytes, not just holder sets).
-        # Entries live and die with object_locations.
-        self.object_sizes: Dict[bytes, int] = {}  # guarded-by: _lock
-        # storage tier per (object, node): "hbm" marks a live device
-        # (accelerator) copy pinned by a process on that node — visible
-        # to locality scoring and the state API, but NOT host-readable
-        # (get_object_locations filters it so the transfer plane never
-        # tries to shm-read HBM). "shm" is the default host tier; a
-        # host copy written later (materialization/demotion) overwrites
-        # the tag. Entries live and die with object_locations.
-        self.object_tiers: Dict[bytes, Dict[NodeID, str]] = defaultdict(dict)  # guarded-by: _lock
+        # The object directory is lock-striped into shards keyed by oid
+        # (gcs_directory_shards) so add/remove/locate traffic from
+        # different nodes never contends on one lock — the GCS-side half
+        # of the decentralized control plane. Shard locks are LEAF locks:
+        # nothing is acquired while holding one, and batched operations
+        # take one shard lock at a time (never two at once), so no
+        # ordering edges exist between them.
+        self._num_shards = resolve_directory_shards(directory_shards)
+        self._shards = [_DirectoryShard() for _ in range(self._num_shards)]
         self._node_index = 0  # guarded-by: _lock
+
+    def _shard(self, oid: bytes) -> _DirectoryShard:
+        return self._shards[hash(oid) % self._num_shards]
+
+    def _by_shard(self, oids) -> Dict[int, list]:
+        """Group a batch of oids by shard index so batched lookups
+        acquire each touched shard lock exactly once."""
+        groups: Dict[int, list] = defaultdict(list)
+        for oid in oids:
+            groups[hash(oid) % self._num_shards].append(oid)
+        return groups
 
     # -- jobs ----------------------------------------------------------------
     # The job table (GcsJobManager analog, gcs_job_manager.h:28): one row
@@ -250,35 +291,45 @@ class GCS:
             return [k for k in self.kv if k.startswith(prefix)]
 
     # -- object directory ----------------------------------------------------
+    # Sharded: every method routes through the oid's _DirectoryShard and
+    # takes only that shard's (leaf) lock; batched calls group by shard
+    # and acquire each touched shard lock once.
     def add_object_location(self, oid: bytes, node_id: NodeID,
                             size: Optional[int] = None,
                             tier: str = "shm") -> None:
-        with self._lock:
-            self.object_locations[oid].add(node_id)
-            self.object_tiers[oid][node_id] = tier
+        sh = self._shard(oid)
+        with sh.lock:
+            locs = sh.locations.get(oid)
+            if locs is None:
+                locs = sh.locations[oid] = set()
+                sh.tiers[oid] = {}
+            locs.add(node_id)
+            sh.tiers[oid][node_id] = tier
             if size is not None:
-                self.object_sizes[oid] = size
+                sh.sizes[oid] = size
 
     def remove_object_location(self, oid: bytes, node_id: NodeID) -> None:
-        with self._lock:
-            locs = self.object_locations.get(oid)
+        sh = self._shard(oid)
+        with sh.lock:
+            locs = sh.locations.get(oid)
             if locs:
                 locs.discard(node_id)
-                tiers = self.object_tiers.get(oid)
+                tiers = sh.tiers.get(oid)
                 if tiers:
                     tiers.pop(node_id, None)
                 if not locs:
-                    del self.object_locations[oid]
-                    self.object_sizes.pop(oid, None)
-                    self.object_tiers.pop(oid, None)
+                    del sh.locations[oid]
+                    sh.sizes.pop(oid, None)
+                    sh.tiers.pop(oid, None)
 
     def remove_device_location(self, oid: bytes, node_id: NodeID) -> None:
         """Drop a holder only while its copy is still device-tier: the
         owner process died or consumed the buffer. A host copy written
         since (materialization overwrote the tag to 'shm') survives —
         it lives in the node store, not the dead process."""
-        with self._lock:
-            if self.object_tiers.get(oid, {}).get(node_id) != "hbm":
+        sh = self._shard(oid)
+        with sh.lock:
+            if sh.tiers.get(oid, {}).get(node_id) != "hbm":
                 return
         self.remove_object_location(oid, node_id)
 
@@ -286,29 +337,41 @@ class GCS:
         """HOST-READABLE holders only: device-tier (hbm) copies are live
         process-local jax buffers the transfer plane cannot shm-read —
         those readers go through the materialization path instead."""
-        with self._lock:
-            tiers = self.object_tiers.get(oid, {})
-            return {n for n in self.object_locations.get(oid, ())
+        sh = self._shard(oid)
+        with sh.lock:
+            tiers = sh.tiers.get(oid, {})
+            return {n for n in sh.locations.get(oid, ())
                     if tiers.get(n, "shm") != "hbm"}
 
     def locate_objects(self, oids) -> Dict[bytes, tuple]:
         """Batched directory lookup for the scheduler's locality pass:
-        ``{oid: (size_bytes, (holder NodeIDs...), {node: tier})}`` under
-        ONE lock acquisition (the router calls this once per scheduling
-        batch, not per oid per candidate node). Size is 0 when the
-        directory never learned it (the holder set is still valid — the
-        scheduler just can't weigh those bytes). Holders INCLUDE
-        device-tier (hbm) copies — an HBM-resident argument is the best
-        possible placement target — with the tier map telling readers
-        which holders are host-readable. Objects with no live directory
-        entry are absent from the result."""
+        ``{oid: (size_bytes, (holder NodeIDs...), {node: tier})}`` with
+        ONE lock acquisition per touched shard (the router calls this
+        once per scheduling batch, not per oid per candidate node). Size
+        is 0 when the directory never learned it (the holder set is
+        still valid — the scheduler just can't weigh those bytes).
+        Holders INCLUDE device-tier (hbm) copies — an HBM-resident
+        argument is the best possible placement target — with the tier
+        map telling readers which holders are host-readable. Objects
+        with no live directory entry are absent from the result."""
         out: Dict[bytes, tuple] = {}
-        with self._lock:
-            for oid in oids:
-                locs = self.object_locations.get(oid)
-                if locs:
-                    out[oid] = (self.object_sizes.get(oid, 0), tuple(locs),
-                                dict(self.object_tiers.get(oid, {})))
+        for idx, group in self._by_shard(oids).items():
+            sh = self._shards[idx]
+            with sh.lock:
+                for oid in group:
+                    locs = sh.locations.get(oid)
+                    if locs:
+                        out[oid] = (sh.sizes.get(oid, 0), tuple(locs),
+                                    dict(sh.tiers.get(oid, {})))
+        return out
+
+    def directory_keys(self) -> List[bytes]:
+        """Every oid with a live directory entry (the state API's object
+        listing), merged across shards — one lock acquisition each."""
+        out: List[bytes] = []
+        for sh in self._shards:
+            with sh.lock:
+                out.extend(sh.locations.keys())
         return out
 
     def prune_location(self, oid: bytes, node_id: NodeID) -> None:
@@ -332,34 +395,88 @@ class GCS:
 
     def take_objects_locations(self, oids) -> Dict[bytes, Set[NodeID]]:
         """Batch pop: every listed object's location set, removed from
-        the directory, ONE lock acquisition. The free path over a
-        completion burst calls this once instead of 2N per-oid calls
-        (per-oid get+remove was a measurable slice of the router's free
-        work at high task rates); oids with no locations — inline
-        returns — are simply absent from the result."""
+        the directory, ONE lock acquisition per touched shard. The free
+        path over a completion burst calls this once instead of 2N
+        per-oid calls (per-oid get+remove was a measurable slice of the
+        router's free work at high task rates); oids with no locations —
+        inline returns — are simply absent from the result."""
         out: Dict[bytes, Set[NodeID]] = {}
-        with self._lock:
-            for oid in oids:
-                locs = self.object_locations.pop(oid, None)
-                self.object_sizes.pop(oid, None)
-                self.object_tiers.pop(oid, None)
-                if locs:
-                    out[oid] = locs
+        for idx, group in self._by_shard(oids).items():
+            sh = self._shards[idx]
+            with sh.lock:
+                for oid in group:
+                    locs = sh.locations.pop(oid, None)
+                    sh.sizes.pop(oid, None)
+                    sh.tiers.pop(oid, None)
+                    if locs:
+                        out[oid] = locs
         return out
 
     def drop_node_objects(self, node_id: NodeID) -> List[bytes]:
         """Remove a dead node from the directory; returns objects that now
         have zero locations (candidates for lineage reconstruction)."""
         orphaned = []
-        with self._lock:
-            for oid, locs in list(self.object_locations.items()):
-                locs.discard(node_id)
-                tiers = self.object_tiers.get(oid)
-                if tiers:
-                    tiers.pop(node_id, None)
-                if not locs:
-                    del self.object_locations[oid]
-                    self.object_sizes.pop(oid, None)
-                    self.object_tiers.pop(oid, None)
-                    orphaned.append(oid)
+        for sh in self._shards:
+            with sh.lock:
+                for oid, locs in list(sh.locations.items()):
+                    locs.discard(node_id)
+                    tiers = sh.tiers.get(oid)
+                    if tiers:
+                        tiers.pop(node_id, None)
+                    if not locs:
+                        del sh.locations[oid]
+                        sh.sizes.pop(oid, None)
+                        sh.tiers.pop(oid, None)
+                        orphaned.append(oid)
         return orphaned
+
+    # -- recoverable head state ----------------------------------------------
+    # With a durable storage backend, small sealed object VALUES ride a
+    # write-ahead log (ns "sealed_objects") and the directory's
+    # oid -> size map snapshots per shard (ns "dir_snapshot"), so a head
+    # restart can restore every sealed small object and sweep directory
+    # rows whose holders died with the old process tree. The runtime
+    # gates the WAL on config (sealed_wal_max_bytes); these helpers are
+    # storage plumbing only.
+    def wal_put_sealed(self, oid: bytes, payload: bytes) -> None:
+        self.storage.put("sealed_objects", oid.hex(), payload)
+
+    def wal_del_sealed(self, oids) -> None:
+        for oid in oids:
+            self.storage.delete("sealed_objects", oid.hex())
+
+    def wal_sealed_items(self) -> List[tuple]:
+        return [(bytes.fromhex(k), v)
+                for k, v in self.storage.items("sealed_objects")]
+
+    def snapshot_directory(self) -> None:
+        """Persist each shard's oid -> size map (holder sets are process
+        identities and meaningless across a restart). One storage row
+        per NON-EMPTY shard; empty shards delete their row so the
+        snapshot never accretes stale entries."""
+        import pickle
+
+        for i, sh in enumerate(self._shards):
+            with sh.lock:
+                rows = {oid: sh.sizes.get(oid, 0) for oid in sh.locations}
+            if rows:
+                self.storage.put("dir_snapshot", str(i),
+                                 pickle.dumps(rows, protocol=4))
+            else:
+                self.storage.delete("dir_snapshot", str(i))
+
+    def take_directory_snapshot(self) -> Dict[bytes, int]:
+        """Read-and-clear the persisted directory snapshot (boot path).
+        Returned entries describe objects sealed before the restart;
+        the caller restores WAL-backed values and sweeps the rest —
+        their shm-store holders died with the old process tree."""
+        out: Dict[bytes, int] = {}
+        import pickle
+
+        for key, blob in self.storage.items("dir_snapshot"):
+            try:
+                out.update(pickle.loads(blob))
+            except Exception:  # noqa: BLE001 — corrupt row: sweep it
+                pass
+            self.storage.delete("dir_snapshot", key)
+        return out

@@ -68,6 +68,15 @@ DEFS: Dict[str, tuple] = {
     "rmt_prefetch_completed_total": (Counter, dict(
         description="Argument prestage pulls that landed (task's args "
                     "were store-resident before a worker asked).")),
+    "rmt_sched_local_placed_total": (Counter, dict(
+        description="Leaf tasks placed through the agent-local lease "
+                    "fast path (bulk-granted credits; no cluster-"
+                    "scheduler pass, no per-task head routing).")),
+    "rmt_sched_local_spillback_total": (Counter, dict(
+        description="Leaf tasks spilled back to the head router: no "
+                    "node had lease credits, or a saturated/dead agent "
+                    "returned the lease (the two-level raylet spillback "
+                    "hop, raylet_client.h:398).")),
     # object / device stores
     "rmt_object_store_bytes": (Gauge, dict(
         description="Shared-memory object store bytes in use per node.",
@@ -467,6 +476,14 @@ def stale_creates_aborted() -> Counter:
 
 def object_directory_prunes() -> Counter:
     return get("rmt_object_directory_prunes_total")
+
+
+def sched_local_placed() -> Counter:
+    return get("rmt_sched_local_placed_total")
+
+
+def sched_local_spillback() -> Counter:
+    return get("rmt_sched_local_spillback_total")
 
 
 def train_checkpoint_saves() -> Counter:

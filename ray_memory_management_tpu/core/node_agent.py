@@ -20,6 +20,7 @@ actor lifecycles — working unchanged for remote workers).
 Channel frames, head -> agent:
     start_worker {wid, dedicated, env}     spawn a worker process
     wsend       {wid, msg}                 deliver msg to worker wid
+    lease_exec  {task_id, msg}             leaf task: agent picks the worker
     kill_worker {wid}                      terminate a worker process
     obj_push    {oid, size}                begin receiving an object
     obj_chunk   {oid, off, data}           one chunk of it
@@ -33,6 +34,8 @@ agent -> head:
     register_node {...}                    hello (first frame)
     wmsg        {wid, msg}                 tunneled worker message
     wdeath      {wid}                      worker pipe EOF
+    lease_spill {task_id}                  leaf pool saturated: head reroutes
+    lease_dead  {task_id}                  leased task's worker died
     push_ack    {req, error}               object landed (or failed)
     pull_data   {req, off, data, eof, error}
     pong
@@ -169,6 +172,19 @@ class NodeAgent:
         self._worker_procs: Dict[bytes, Any] = {}   # wid -> Popen  # guarded-by: _lock
         self._pending_bootstrap: Dict[bytes, dict] = {}  # cold-spawn tokens  # guarded-by: _lock
         self._worker_send_locks: Dict[bytes, threading.Lock] = {}  # guarded-by: _lock
+        # agent-local leaf scheduling (lease_exec): the head grants this
+        # node lease credits in bulk; each lease_exec frame carries a
+        # fully-built exec msg and THIS process picks the least-loaded
+        # connected pool worker — the decentralized-control-plane half of
+        # the two-level lease protocol (raylet_client.h:398). Dedicated
+        # (actor / conda) workers never take leased tasks.
+        self._lease_dedicated: set = set()          # wid  # guarded-by: _lock
+        self._lease_inflight: Dict[bytes, int] = {}  # wid -> depth  # guarded-by: _lock
+        self._lease_task_wid: Dict[bytes, bytes] = {}  # task -> wid  # guarded-by: _lock
+        self._lease_known: Dict[bytes, set] = {}    # wid -> fn ids  # guarded-by: _lock
+        # fn blobs ship once per NODE (head-side lease_known_fns); the
+        # agent re-attaches from this cache per WORKER as needed
+        self._lease_fn_blobs: Dict[bytes, bytes] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # The object plane runs on its OWN thread: a push/ensure into a
@@ -281,6 +297,7 @@ class NodeAgent:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            self._lease_note_reply(wid, msg)
             try:
                 self._send({"type": "wmsg", "wid": wid, "msg": msg})
             except (OSError, BrokenPipeError):
@@ -288,6 +305,21 @@ class NodeAgent:
         with self._lock:
             self._workers.pop(wid, None)
             self._worker_send_locks.pop(wid, None)
+            # leased tasks bound to this worker die with it: the head
+            # retries them (lease_dead), exactly like its own
+            # worker-death inflight sweep for queue-dispatched tasks
+            dead_leases = [tid for tid, w in self._lease_task_wid.items()
+                           if w == wid]
+            for tid in dead_leases:
+                self._lease_task_wid.pop(tid, None)
+            self._lease_inflight.pop(wid, None)
+            self._lease_known.pop(wid, None)
+            self._lease_dedicated.discard(wid)
+        for tid in dead_leases:
+            try:
+                self._send({"type": "lease_dead", "task_id": tid})
+            except (OSError, BrokenPipeError):
+                break
         try:
             self._send({"type": "wdeath", "wid": wid})
         except (OSError, BrokenPipeError):
@@ -298,6 +330,10 @@ class NodeAgent:
 
         wid_hex = msg["wid_hex"]
         wid = bytes.fromhex(wid_hex)
+        if msg.get("dedicated") or msg.get("conda") is not None:
+            # actor / conda workers never take leased leaf tasks
+            with self._lock:
+                self._lease_dedicated.add(wid)
         env = build_worker_env(wid_hex, self.node_id.hex(), self.store_name,
                                self._socket_path, "",
                                self.config)
@@ -347,6 +383,90 @@ class NodeAgent:
 
         threading.Thread(target=resolve_and_spawn, daemon=True,
                          name=f"conda-spawn-{wid_hex[:6]}").start()
+
+    # ------------------------------------------------------------ leaf leases
+    def _lease_exec(self, msg: dict) -> None:
+        """Place one leased leaf task on a local pool worker — the
+        agent-local scheduling decision. Saturation (every eligible
+        worker at the pipelining depth) spills the task back to the head
+        router (lease_spill), which reroutes it through the full
+        scheduling path; a vanished worker is reported as lease_dead so
+        the head can retry. Runs on the channel recv loop and never
+        parks: the decision is a dict scan under _lock."""
+        task_id = msg["task_id"]
+        inner = msg["msg"]
+        fn_id = inner.get("fn_id")
+        blob = inner.pop("fn_blob", None)
+        depth = max(1, self.config.max_tasks_in_flight_per_worker)
+        attach = False
+        with self._lock:
+            if blob is not None and fn_id is not None:
+                self._lease_fn_blobs[fn_id] = blob
+            best = None
+            best_n = depth
+            for wid in self._workers:
+                if wid in self._lease_dedicated:
+                    continue
+                n = self._lease_inflight.get(wid, 0)
+                if n < best_n:
+                    best, best_n = wid, n
+                    if n == 0:
+                        break
+            if best is not None:
+                conn = self._workers.get(best)
+                lock = self._worker_send_locks.get(best)
+                known = self._lease_known.setdefault(best, set())
+                if fn_id is not None and fn_id not in known:
+                    blob = self._lease_fn_blobs.get(fn_id)
+                    if blob is None:
+                        best = None  # blob never arrived: cannot run here
+                    else:
+                        known.add(fn_id)
+                        attach = True
+                if best is not None:
+                    self._lease_inflight[best] = best_n + 1
+                    self._lease_task_wid[task_id] = best
+        if best is None:
+            try:
+                self._send({"type": "lease_spill", "task_id": task_id})
+            except (OSError, BrokenPipeError):
+                pass
+            return
+        if attach:
+            inner = dict(inner)
+            inner["fn_blob"] = blob
+        try:
+            with lock:
+                conn.send(inner)
+        except (OSError, BrokenPipeError, ValueError):
+            # the pick raced the worker's death: unbind and tell the head
+            # (its retry path reruns the task elsewhere). The reader's EOF
+            # sweep may race this — finish_leaf at the head is idempotent.
+            with self._lock:
+                self._lease_task_wid.pop(task_id, None)
+                n = self._lease_inflight.get(best, 0)
+                if n > 0:
+                    self._lease_inflight[best] = n - 1
+            try:
+                self._send({"type": "lease_dead", "task_id": task_id})
+            except (OSError, BrokenPipeError):
+                pass
+
+    def _lease_note_reply(self, wid: bytes, msg: dict) -> None:
+        """Settle lease depth accounting from a tunneled worker reply
+        (done frames, possibly inside a batch)."""
+        t = msg.get("type")
+        if t == "batch":
+            for m in msg["msgs"]:
+                self._lease_note_reply(wid, m)
+            return
+        if t == "done":
+            with self._lock:
+                if self._lease_task_wid.pop(msg.get("task_id"),
+                                            None) is not None:
+                    n = self._lease_inflight.get(wid, 0)
+                    if n > 0:
+                        self._lease_inflight[wid] = n - 1
 
     def _reap_loop(self) -> None:
         """Detect workers that die WITHOUT ever dialing in (import error,
@@ -671,12 +791,18 @@ class NodeAgent:
                 with self._lock:
                     conn = self._workers.get(wid)
                     lock = self._worker_send_locks.get(wid)
+                    if msg["msg"].get("type") == "create_actor":
+                        # a pooled worker converted into an actor worker
+                        # (dedicate_to_actor): stop leasing onto it
+                        self._lease_dedicated.add(wid)
                 if conn is not None and lock is not None:
                     try:
                         with lock:
                             conn.send(msg["msg"])
                     except (OSError, BrokenPipeError, ValueError):
                         pass  # reader thread will report wdeath
+            elif t == "lease_exec":
+                self._lease_exec(msg)
             elif t == "start_worker":
                 self._start_worker(msg)
             elif t == "kill_worker":

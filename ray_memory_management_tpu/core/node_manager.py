@@ -144,6 +144,10 @@ def build_worker_env(worker_id_hex: str, node_id_hex: str, store_name: str,
         "RMT_AUTHKEY": authkey_hex,
         "RMT_INLINE_LIMIT": str(config.max_direct_call_object_size),
         "RMT_LOG_TO_DRIVER": "1" if config.log_to_driver else "0",
+        # pipelined done-reply batching (worker _ReplySender adaptive
+        # flush window); explicit so local pool and agent spawn agree
+        "RMT_REPLY_FLUSH_WINDOW_S": str(config.reply_flush_window_s),
+        "RMT_REPLY_FLUSH_MAX": str(config.reply_flush_max),
         "JAX_PLATFORMS": env.get("RMT_WORKER_JAX_PLATFORMS", "cpu"),
     })
     if env["JAX_PLATFORMS"] == "cpu":
@@ -242,6 +246,30 @@ class NodeManager:
         # phase accounting (scale bench): spawn-return -> worker-ready
         self.boot_seconds = 0.0
         self.boot_count = 0
+        # leaf-lease pool (decentralized control plane): a bulk credit
+        # grant that lets the router place constraint-free leaf tasks on
+        # this node WITHOUT the full pick_node/locality pass, and lets a
+        # remote node's agent pick the worker itself (the two-level
+        # lease protocol the ClusterScheduler docstring reserves;
+        # raylet_client.h:398). Credits resolve once per node: the flag,
+        # or 2x the node's CPU count; negative disables leaf leasing.
+        slots = self.config.leaf_lease_slots
+        if slots == 0:
+            slots = max(2, int(self.resources.total.get(CPU)) * 2)
+        # construction runs outside __init__ (RemoteNodeManager path),
+        # so take the lock to honor the annotations lexically
+        with self._lock:
+            self.leaf_credits = max(0, slots)  # guarded-by: _lock
+            # local-mode markers: leaf tasks riding the ordinary
+            # dispatch queue, so finish_task knows to return the credit
+            self.leaf_local: Set[bytes] = set()  # guarded-by: _lock
+            # remote-mode inflight: specs handed to the node's AGENT for
+            # agent-local worker placement (lease_exec); drained by the
+            # node-death handler exactly like the dispatch queue
+            self.leaf_inflight: Dict[bytes, TaskSpec] = {}  # guarded-by: _lock
+            # fn ids whose blob already rode a lease_exec to this
+            # node's agent (the agent caches blobs; per-node ships-once)
+            self.lease_known_fns: Set[bytes] = set()  # guarded-by: _lock
 
     # -- worker pool ----------------------------------------------------------
     def start_conda_worker(self, conda_spec, conda_key: str) -> None:
@@ -448,6 +476,56 @@ class NodeManager:
                 len(h.inflight) - 1
                 for h in self.busy_pool if len(h.inflight) > 1
             )
+
+    # -- leaf leases ----------------------------------------------------------
+    def submit_leaf(self, spec: TaskSpec, build_msg=None) -> bool:
+        """Admit one leaf task against this node's lease-credit pool.
+
+        Local nodes just ride the ordinary dispatch queue (the win is
+        skipping the router's pick_node/locality pass, not the queue);
+        the credit is returned by finish_task via the leaf_local marker.
+        Returns False when the pool is saturated (the caller counts a
+        spillback and falls through to the full scheduling path) or the
+        node is dead. ``build_msg`` is only used by the remote override.
+        """
+        with self._lock:
+            if not self.alive or self.leaf_credits <= 0:
+                return False
+            self.leaf_credits -= 1
+            self.leaf_local.add(spec.task_id)
+            self.queue.append(spec)
+        return True
+
+    def finish_leaf(self, task_id: bytes) -> Optional[TaskSpec]:
+        """Settle an agent-placed leaf task (done reply, spillback, or
+        worker death): return its credit and hand back the spec. Local
+        leaf tasks live in handle.inflight instead, so this returns None
+        for them — finish_task settles their credit."""
+        with self._lock:
+            spec = self.leaf_inflight.pop(task_id, None)
+            if spec is not None:
+                self.leaf_credits += 1
+            return spec
+
+    def release_leaf(self, task_id: bytes) -> None:
+        """Return the credit of a LOCAL leaf task whose worker died
+        before finish_task could run (the death handler cleared the
+        handle's inflight map wholesale)."""
+        with self._lock:
+            if task_id in self.leaf_local:
+                self.leaf_local.discard(task_id)
+                self.leaf_credits += 1
+
+    def take_leaf_inflight(self) -> Dict[bytes, TaskSpec]:
+        """Node death: drain every agent-placed leaf task for retry
+        elsewhere (the lease-revocation half of the dead-flag-then-drain
+        ordering — the dead flag is already set, so no new lease_exec
+        can land behind this drain)."""
+        with self._lock:
+            out = dict(self.leaf_inflight)
+            self.leaf_inflight.clear()
+            self.leaf_credits += len(out)
+            return out
 
     def try_dispatch(
         self, send: Callable[[WorkerHandle, TaskSpec], None]
@@ -661,6 +739,10 @@ class NodeManager:
             spec = handle.inflight.pop(task_id, None)
             if spec is not None and spec.runtime_env:
                 handle.re_inflight -= 1
+            if task_id in self.leaf_local:
+                # local-mode leaf task: its lease credit frees with it
+                self.leaf_local.discard(task_id)
+                self.leaf_credits += 1
             if handle.inflight:
                 return  # pipelined tasks still riding this lease
             if handle.lease_resources is not None:

@@ -74,7 +74,8 @@ class NodeObjectStore:
         # shared directory one store's restore/delete would remove another
         # store's spill file
         base = self.config.object_store_fallback_directory.rstrip("/")
-        self._storage = ext.storage_for_uri(base + "/" + name.strip("/"))
+        self._storage = ext.storage_for_uri(base + "/" + name.strip("/"),
+                                            config=self.config)
         self._io = ThreadPoolExecutor(
             max_workers=self.config.max_io_workers,
             thread_name_prefix=f"io-{name.strip('/')}",

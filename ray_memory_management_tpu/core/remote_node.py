@@ -386,6 +386,31 @@ class RemoteNodeManager(NodeManager):
         if msg.get("eof"):
             state["event"].set()
 
+    # ------------------------------------------------------------- leaf leases
+    def submit_leaf(self, spec, build_msg=None) -> bool:
+        """Agent-local leaf placement: spend a lease credit and ship the
+        fully-built exec frame to the node's AGENT, which picks the
+        worker itself (lease_exec). The head's only per-task work is the
+        frame build — no pick_node, no dispatch queue, no try_dispatch
+        round. The agent answers lease_spill when its pool is saturated
+        (credit returned via finish_leaf, task re-enters the router) and
+        lease_dead when the chosen worker dies mid-task."""
+        if build_msg is None:
+            return False
+        with self._lock:
+            if not self.alive or self.leaf_credits <= 0:
+                return False
+            self.leaf_credits -= 1
+            self.leaf_inflight[spec.task_id] = spec
+        msg = build_msg(self, spec)
+        if not self.channel_send({"type": "lease_exec",
+                                  "task_id": spec.task_id, "msg": msg}):
+            with self._lock:
+                self.leaf_credits += 1
+                self.leaf_inflight.pop(spec.task_id, None)
+            return False
+        return True
+
     # ------------------------------------------------------------ worker pool
     def start_conda_worker(self, conda_spec, conda_key: str) -> None:
         """Remote flavor of the dedicated conda-env worker: the env is

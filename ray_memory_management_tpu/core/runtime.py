@@ -51,7 +51,7 @@ from . import metrics_defs as mdefs
 from .node_manager import NodeManager, WorkerHandle
 from .object_ref import ObjectRef
 from .object_store import StoreClient
-from .resources import NodeResources, Resources, TPU, task_resources
+from .resources import CPU, NodeResources, Resources, TPU, task_resources
 from .scheduler import ClusterScheduler
 from .scheduling_strategies import PlacementGroupSchedulingStrategy
 from .task_spec import ActorCreationSpec, TaskSpec
@@ -358,6 +358,21 @@ class _ActorInfo:
         self.handle_count = 0
 
 
+class _RefShard:
+    """One stripe of the head's refcount table: a leaf lock over this
+    stripe's counts and its zero-ref free buffer. oids map to stripes by
+    hash, so ref churn on disjoint objects never shares a mutex (the
+    single _ref_mu this replaces was the refcount hot path's last global
+    serialization point)."""
+
+    __slots__ = ("lock", "refs", "frees")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.refs: Dict[bytes, int] = defaultdict(int)  # guarded-by: lock
+        self.frees: List[bytes] = []  # zero-ref batch buffer  # guarded-by: lock
+
+
 class Runtime:
     def __init__(self, config: Config, nodes_spec: List[dict],
                  namespace: Optional[str] = None):
@@ -369,7 +384,8 @@ class Runtime:
         reap_stale_stores("rmt_")  # SIGKILLed drivers leave orphans
         from .gcs_storage import open_storage
 
-        self.gcs = GCS(open_storage(config.gcs_storage_path))
+        self.gcs = GCS(open_storage(config.gcs_storage_path),
+                       directory_shards=config.gcs_directory_shards)
         import sys as _sys
 
         self.gcs.register_job(self.job_id.binary(), {
@@ -409,21 +425,27 @@ class Runtime:
         self._promises: Set[bytes] = set()  # guarded-by: _lock
         self.tasks: Dict[bytes, _TaskRecord] = {}  # guarded-by: _lock
         self.lineage: Dict[bytes, bytes] = {}  # object id -> producing task id  # guarded-by: _lock
-        self.local_refs: Dict[bytes, int] = defaultdict(int)  # guarded-by: _ref_mu
-        # dedicated refcount shard: ObjectRef __del__/__init__ storms on
-        # the APPLICATION thread must not contend with the router's
-        # dispatch/completion work under the big runtime lock (the
-        # task-hot-path profile showed exactly that contention). Guards
-        # local_refs + _deferred_frees only. Lock order: _ref_mu nests
-        # INSIDE _lock; never take _lock while holding _ref_mu.
-        self._ref_mu = threading.Lock()
+        # lock-STRIPED refcount shards (decentralized control plane):
+        # ObjectRef __del__/__init__ storms on the APPLICATION thread,
+        # worker ref-table ingestion, and the router's completion sweep
+        # each touch disjoint oids most of the time — one refcount mutex
+        # (the old _ref_mu) serialized them all. Each shard guards its
+        # own refs dict + zero-ref free buffer; oid -> shard by hash.
+        # Lock order: shard locks are LEAF locks nesting INSIDE _lock;
+        # never take _lock (or a second shard) while holding one —
+        # multi-oid paths acquire shards one at a time, or in ascending
+        # index order when a check must span several (_try_prune).
+        from .gcs import resolve_directory_shards
+
+        self._ref_shard_n = resolve_directory_shards(
+            config.gcs_directory_shards)
+        self._ref_shards = [_RefShard() for _ in range(self._ref_shard_n)]
         self.actors: Dict[bytes, _ActorInfo] = {}
         self.fn_blobs: Dict[bytes, bytes] = {}
         self.cls_blobs: Dict[bytes, bytes] = {}
         self._waiting_deps: Dict[bytes, Set[bytes]] = {}  # task -> missing oids  # guarded-by: _lock
         self._dep_waiters: Dict[bytes, List[bytes]] = defaultdict(list)  # guarded-by: _lock
         self._pending_schedule: deque = deque()  # guarded-by: _lock
-        self._deferred_frees: List[bytes] = []  # zero-ref batch buffer  # guarded-by: _ref_mu
         # decentralized ownership bookkeeping (reference_count.h:39-61):
         # per-worker borrow pins (each holds one local_refs count until
         # the worker releases or dies) and per-worker owned-put
@@ -480,6 +502,29 @@ class Runtime:
         self._m_stage_hist = mdefs.task_stage_seconds()
         self._m_prefetch_started = mdefs.prefetch_started()
         self._m_prefetch_completed = mdefs.prefetch_completed()
+        self._m_leaf_placed = mdefs.sched_local_placed()
+        self._m_leaf_spill = mdefs.sched_local_spillback()
+        self._leaf_rr = 0  # round-robin cursor over nodes (router only)
+        self._leaf_run = 0  # tasks placed on the cursor node this run (router only)
+        # recoverable head state: sealed small objects WAL through the
+        # durable GCS kv (gcs_storage_path); directory snapshots ride
+        # the heartbeat loop. Volatile (in-memory) storage skips both.
+        self._wal_enabled = (self.gcs.durable
+                             and config.sealed_wal_max_bytes > 0)
+        self._wal_max = config.sealed_wal_max_bytes
+        self._hb_ticks = 0
+        if self.gcs.durable:
+            # the previous head's directory rows name holders (stores,
+            # workers) that died with its process tree: sweep them, then
+            # restore every WAL-sealed object — a head restart loses no
+            # sealed object (unsealed creates have no WAL row, so they
+            # are swept with the directory)
+            self.gcs.take_directory_snapshot()
+            for oid, payload in self.gcs.wal_sealed_items():
+                self.memory_store[oid] = payload
+                fut = _SlimFuture()
+                fut.set_result(True)
+                self.futures[oid] = fut
         # dep-ready tasks awaiting scheduling, drained in BATCHES by the
         # router's pump: per-task inline scheduling cost ~7 lock/notify
         # round-trips; batching pays them once per burst (the reference
@@ -521,6 +566,20 @@ class Runtime:
         from ..utils import faults as _faults
 
         _faults.configure_from(config)
+        # agent-local leaf scheduling: constraint-free small tasks take a
+        # per-node lease credit (NodeManager.submit_leaf) instead of the
+        # full pick_node pass; disabled under fault injection so chaos
+        # runs keep exercising the battle-tested dispatch/retry path
+        # (the leaf path intentionally skips the control.dispatch site)
+        self._leaf_enabled = (
+            config.leaf_lease_slots >= 0
+            and not getattr(config, "fault_injection_spec", ""))
+        from ..utils.retry import RetryPolicy
+
+        # one dispatch policy for every queue hand-off (hoisted: a
+        # policy object per submit showed in the task hot path)
+        self._dispatch_retry = RetryPolicy(
+            max_attempts=3, base_backoff_s=0.02, plane="dispatch")
         self._wakeup_r, self._wakeup_w = os.pipe()
         self._stop = threading.Event()
         self.pg_manager = None  # set by placement_group module on first use
@@ -660,6 +719,11 @@ class Runtime:
         # router will observe EOFs; handle queued (not yet dispatched) tasks
         for spec in requeue:
             self._schedule(spec)
+        # agent-leased leaf tasks died with the node (the agent can no
+        # longer report lease_dead) — retry them under their budget
+        for task_id, spec in nm.take_leaf_inflight().items():
+            self._maybe_retry(task_id, spec, WorkerCrashedError(
+                f"node died with leased task {spec.name} in flight"))
         self.gcs.drop_node_objects(node_id)
         self._wakeup()
 
@@ -860,6 +924,23 @@ class Runtime:
             # shm store name, which same-host peers map directly
             nm.transfer_addr = (msg["host"], msg["port"])
             nm.remote_store_name = msg.get("store_name")
+        elif mtype == "lease_spill":
+            # the agent's local pool is saturated: take the lease credit
+            # back and reroute through the full scheduling pass (NOT the
+            # leaf path — spillbacks ride _pending_schedule)
+            spec = nm.finish_leaf(msg["task_id"])
+            if spec is not None:
+                self._m_leaf_spill.inc()
+                with self._lock:
+                    self._pending_schedule.append(spec)
+                self._wakeup()
+        elif mtype == "lease_dead":
+            # the worker the agent picked died before replying; the
+            # agent unbound the lease — retry under the task's budget
+            spec = nm.finish_leaf(msg["task_id"])
+            if spec is not None:
+                self._maybe_retry(msg["task_id"], spec, WorkerCrashedError(
+                    f"leased worker died running {spec.name}"))
         elif mtype == "wdeath":
             handle = nm.worker_by_wid(msg["wid"])
             if handle is not None:
@@ -915,6 +996,10 @@ class Runtime:
             self._on_worker_death(h)
         for spec in requeue:
             self._schedule(spec)
+        # leases the dead agent held: no lease_dead frame is coming
+        for task_id, spec in nm.take_leaf_inflight().items():
+            self._maybe_retry(task_id, spec, WorkerCrashedError(
+                f"node agent died with leased task {spec.name} in flight"))
         self.gcs.drop_node_objects(nm.node_id)
         self._wakeup()
 
@@ -1291,22 +1376,21 @@ class Runtime:
         with self._lock:
             self.tasks[spec.task_id] = rec
             self._index_trace_locked(trace_ctx, spec.task_id)
-            with self._ref_mu:
-                for oid in return_ids:
-                    self.futures[oid] = _SlimFuture()
-                    self.lineage[oid] = spec.task_id
-                    if adopt_returns:
-                        # pre-registered handle ref, ADOPTED by the
-                        # caller's ObjectRef: without it a fast task
-                        # completing before the wrap would see refcount
-                        # zero and GC its result
-                        self.local_refs[oid] += 1
-                # the pending task keeps its ref args (and their
-                # lineage) alive even if the caller drops every handle
-                # before it runs
-                for oid in self._ref_deps(spec):
-                    self.local_refs[oid] += 1
-                    self._lineage_dependents[oid] += 1
+            for oid in return_ids:
+                self.futures[oid] = _SlimFuture()
+                self.lineage[oid] = spec.task_id
+                if adopt_returns:
+                    # pre-registered handle ref, ADOPTED by the
+                    # caller's ObjectRef: without it a fast task
+                    # completing before the wrap would see refcount
+                    # zero and GC its result
+                    self._incref(oid)
+            # the pending task keeps its ref args (and their
+            # lineage) alive even if the caller drops every handle
+            # before it runs
+            for oid in self._ref_deps(spec):
+                self._incref(oid)
+                self._lineage_dependents[oid] += 1
             nudge = self._queue_when_deps_ready_locked(spec)
         if nudge:
             self._wakeup()
@@ -1404,6 +1488,90 @@ class Runtime:
             self._m_failed.inc()
         self._release_task_args(spec)
 
+    # --------------------------------------------- agent-local leaf scheduling
+    def _leaf_eligible(self, spec: TaskSpec) -> bool:
+        """A LEAF task may bypass the head's full placement pass: no
+        placement-group/affinity constraint, no runtime_env, not an
+        actor method, at most one CPU (and nothing else), and every ref
+        arg already in the driver memory store — so the exec frame is
+        self-contained (args inline, no transfer planning, no locality
+        scoring)."""
+        if (spec.is_actor_task or spec.strategy is not None
+                or spec.placement is not None or spec.runtime_env):
+            return False
+        req = spec.req
+        for name in req.names():
+            if name == CPU:
+                if req.get(name) > 1.0:
+                    return False
+            elif req.get(name):
+                return False
+        for oid in self._ref_deps(spec):
+            if oid not in self.memory_store:
+                return False
+        return True
+
+    def _try_leaf_place(self, spec: TaskSpec) -> bool:
+        """Decentralized leaf dispatch: hand the task straight to a node
+        holding spare lease credit (round-robin over nodes), skipping
+        pick_node + locality. A local node rides its ordinary dispatch
+        queue; a remote node gets the fully-built exec frame and its
+        AGENT picks the worker (lease_exec). Every pool saturated →
+        spillback to the shared scheduler."""
+        nodes = list(self.nodes.values())
+        if not nodes:
+            return False
+        n = len(nodes)
+        # sticky round-robin: place short RUNS (4 tasks) on one node
+        # before advancing, so a burst reaches each node as a few
+        # contiguous dispatches instead of a per-task interleave — the
+        # node's dispatch thread wakes once per run, not once per task
+        if self._leaf_run >= 4:
+            self._leaf_rr += 1
+            self._leaf_run = 0
+        start = self._leaf_rr % n
+        placed = False
+        for i in range(n):
+            idx = (start + i) % n
+            nm = nodes[idx]
+            if nm.submit_leaf(spec, self._leaf_task_msg):
+                if idx == start:
+                    self._leaf_run += 1
+                else:
+                    self._leaf_rr, self._leaf_run = idx, 1
+                placed = True
+                break
+        if not placed:
+            self._m_leaf_spill.inc()
+            return False
+        self._m_leaf_placed.inc()
+        with self._lock:
+            rec = self.tasks.get(spec.task_id)
+            if rec:
+                rec.state = "SCHEDULED"
+                rec.ts["SCHEDULED"] = time.time()
+        return True
+
+    def _leaf_task_msg(self, nm, spec: TaskSpec) -> dict:
+        """The exec frame for an agent-routed leaf task. Unlike
+        _task_msg the fn blob ships once per NODE (the agent re-attaches
+        it per worker from its own cache) and args are always inline —
+        _leaf_eligible required every ref dep in the memory store."""
+        args = [self._finalize_arg(a) for a in spec.args]
+        kwargs = {k: self._finalize_arg(v) for k, v in spec.kwargs.items()}
+        msg = {
+            "type": "exec", "task_id": spec.task_id, "fn_id": spec.fn_id,
+            "name": spec.name, "args": args, "kwargs": kwargs,
+            "return_ids": spec.return_ids,
+        }
+        with nm._lock:
+            if spec.fn_id not in nm.lease_known_fns:
+                msg["fn_blob"] = self.fn_blobs[spec.fn_id]
+                nm.lease_known_fns.add(spec.fn_id)
+        if spec.trace_ctx:
+            msg["trace_ctx"] = spec.trace_ctx
+        return msg
+
     def _schedule(self, spec: TaskSpec, pump: bool = True,
                   locality: Optional[Dict[NodeID, int]] = None) -> None:
         if spec.task_id in self._cancelled:
@@ -1442,12 +1610,8 @@ class Runtime:
         RetryPolicy: a transient control.dispatch failure (the injectable
         fault site in NodeManager.submit) is retried with backoff instead
         of failing a task the cluster could still run."""
-        from ..utils.retry import RetryPolicy
-
         try:
-            RetryPolicy(max_attempts=3, base_backoff_s=0.02,
-                        plane="dispatch").run(
-                self.nodes[node_id].submit, spec)
+            self._dispatch_retry.run(self.nodes[node_id].submit, spec)
         except NodeDeadError:
             # the node died between placement and hand-off (e.g. while
             # this task's args were still in transfer) — re-place on a
@@ -1984,6 +2148,19 @@ class Runtime:
         for batch in (submits, pending):
             if not batch:
                 continue
+            if batch is submits and self._leaf_enabled:
+                # leaf fast path: fresh submits only — spillbacks and
+                # retries arrive via _pending_schedule and always take
+                # the full pass (no leaf ping-pong)
+                rest = []
+                for spec in batch:
+                    if (spec.task_id in self._cancelled
+                            or not self._leaf_eligible(spec)
+                            or not self._try_leaf_place(spec)):
+                        rest.append(spec)
+                batch = rest
+                if not batch:
+                    continue
             loc_by_task = self._batch_locality(batch)
             for spec in batch:
                 self._schedule(spec, pump=False,
@@ -2113,8 +2290,14 @@ class Runtime:
         for m in msgs:
             task_id = m["task_id"]
             spec = handle.inflight.get(task_id)
-            if nm:
-                nm.finish_task(handle, task_id)
+            if spec is not None:
+                if nm:
+                    nm.finish_task(handle, task_id)
+            elif nm:
+                # agent-leased leaf task: the head's worker handle never
+                # saw the dispatch, so finish_task would re-idle an
+                # already-idle handle — return the lease credit instead
+                spec = nm.finish_leaf(task_id)
             if spec is not None and spec.placement is not None:
                 self._release_pg_allocation(spec)
             (errored if m["error"] is not None else simple).append((m, spec))
@@ -2137,6 +2320,15 @@ class Runtime:
                 self._fail_task(spec, exc)
         if not simple:
             return
+        if self._wal_enabled:
+            # durability pre-pass BEFORE any future resolves: once a
+            # get() returns, the sealed value must survive a head
+            # restart (the WAL write is the seal). Outside the batch
+            # lock — storage IO must not serialize completions.
+            for m, _spec in simple:
+                for oid, kind, data in m["returns"]:
+                    if kind == "v" and len(data) <= self._wal_max:
+                        self.gcs.wal_put_sealed(oid, data)
         nudge = False
         to_free: List[bytes] = []
         done_t = time.time()  # one stamp for the whole burst
@@ -2190,21 +2382,17 @@ class Runtime:
                 if spec is not None and rec is not None \
                         and not rec.args_released:
                     rec.args_released = True
-                    with self._ref_mu:
-                        for oid in self._ref_deps(spec):
-                            self.local_refs[oid] -= 1
-                            if self.local_refs[oid] <= 0:
-                                del self.local_refs[oid]
-                                to_free.append(oid)
+                    for oid in self._ref_deps(spec):
+                        if self._decref(oid):
+                            to_free.append(oid)
                 if spec is not None and rec is not None and rec.gc_returns:
                     # returns whose every handle was dropped BEFORE the
                     # task finished have no refcount-zero transition left
                     # to trigger GC — sweep them now (driver-owned refs
                     # only: worker/client return handles are bare)
-                    with self._ref_mu:
-                        to_free.extend(
-                            roid for roid in spec.return_ids
-                            if roid not in self.local_refs)
+                    to_free.extend(
+                        roid for roid in spec.return_ids
+                        if not self._ref_held(roid))
         _SlimFuture.broadcast()  # wake getters once for the whole burst
         self._m_finished.inc(len(simple))
         if trace_spans:
@@ -2441,17 +2629,16 @@ class Runtime:
         with self._lock:
             self.tasks[spec.task_id] = rec
             self._index_trace_locked(trace_ctx, spec.task_id)
-            with self._ref_mu:
-                for oid in return_ids:
-                    self.futures[oid] = _SlimFuture()
-                    # lineage here serves record GC, not reconstruction —
-                    # _recover_object refuses actor results explicitly
-                    self.lineage[oid] = spec.task_id
-                    if adopt_returns:
-                        self.local_refs[oid] += 1
-                for oid in self._ref_deps(spec):
-                    self.local_refs[oid] += 1
-                    self._lineage_dependents[oid] += 1
+            for oid in return_ids:
+                self.futures[oid] = _SlimFuture()
+                # lineage here serves record GC, not reconstruction —
+                # _recover_object refuses actor results explicitly
+                self.lineage[oid] = spec.task_id
+                if adopt_returns:
+                    self._incref(oid)
+            for oid in self._ref_deps(spec):
+                self._incref(oid)
+                self._lineage_dependents[oid] += 1
         state = info.record.state
         if state == ACTOR_DEAD:
             self._fail_task(spec, ActorDiedError(
@@ -2640,6 +2827,10 @@ class Runtime:
         nm = self.nodes.get(handle.node_id)
         if nm:
             nm.remove_worker(handle)
+            for task_id in inflight:
+                # a locally-leased leaf task dies with its worker before
+                # finish_task could return the node's lease credit
+                nm.release_leaf(task_id)
         self._release_worker_refs(handle)  # borrow pins die with the worker
         self._drop_device_location(handle)
         if handle.actor_id is not None:
@@ -2771,6 +2962,16 @@ class Runtime:
                 self._refresh_gauges(nodes)
             except Exception:
                 pass  # sampling must never kill the heartbeat loop
+            if self.gcs.durable:
+                # directory shard snapshots ride the heartbeat cadence
+                # (~10 ticks): cheap enough to repeat, fresh enough that
+                # a restarted head knows what the old process held
+                self._hb_ticks += 1
+                if self._hb_ticks % 10 == 0:
+                    try:
+                        self.gcs.snapshot_directory()
+                    except Exception:
+                        pass  # durability is best-effort off the WAL path
             self._stop.wait(interval)
 
     def _refresh_gauges(self, nodes: Optional[List[NodeManager]] = None
@@ -3092,8 +3293,13 @@ class Runtime:
         data = ser.serialize(value)
         oid = ObjectID.for_put().binary()
         if data.total_size <= self.config.max_direct_call_object_size:
+            payload = data.to_bytes()
             with self._lock:
-                self.memory_store[oid] = data.to_bytes()
+                self.memory_store[oid] = payload
+            if self._wal_enabled and len(payload) <= self._wal_max:
+                # sealed the moment put() returns: WAL before the caller
+                # can observe the id (head-restart durability)
+                self.gcs.wal_put_sealed(oid, payload)
         else:
             # release deferred dead objects BEFORE allocating: resident
             # corpses slow the store allocator (free-list walks, eviction
@@ -3133,8 +3339,12 @@ class Runtime:
         if error is None:
             data = ser.serialize(value)
             if data.total_size <= self.config.max_direct_call_object_size:
+                payload = data.to_bytes()
                 with self._lock:
-                    self.memory_store[oid] = data.to_bytes()
+                    self.memory_store[oid] = payload
+                if self._wal_enabled and len(payload) <= self._wal_max:
+                    # WAL before the future resolves (see put_object)
+                    self.gcs.wal_put_sealed(oid, payload)
             else:
                 self._flush_deferred_frees()  # see put_object
                 nm = self.head_node()
@@ -3327,9 +3537,8 @@ class Runtime:
             # must see the args — and its own result — as referenced
             if rec.args_released:
                 rec.args_released = False
-                with self._ref_mu:
-                    for aoid in self._ref_deps(spec):
-                        self.local_refs[aoid] += 1
+                for aoid in self._ref_deps(spec):
+                    self._incref(aoid)
         self._resolve_deps_then_schedule(spec)
         for roid in spec.return_ids:
             with self._lock:
@@ -3458,7 +3667,7 @@ class Runtime:
         invisible to refcounting by design)."""
         wid = handle.worker_id.binary()
         freed: List[bytes] = []
-        with self._lock, self._ref_mu:
+        with self._lock:
             wb = self._worker_borrows.setdefault(wid, set())
             wo = self._worker_owned.get(wid, set())
             # releases BEFORE borrows: one reply can carry both a
@@ -3466,16 +3675,15 @@ class Runtime:
             # re-acquired between two completions) — borrow-first would
             # skip the increment ("already borrowed") and the release
             # would then drop the pin while the worker still holds it
+            # (wb/wo stay under _lock; the counts take one ref stripe
+            # at a time — leaf locks, never two at once)
             for oid in releases or ():
                 if oid in wb:
                     wb.discard(oid)
-                    self.local_refs[oid] -= 1
-                    if self.local_refs[oid] <= 0:
-                        del self.local_refs[oid]
-                        self._deferred_frees.append(oid)
+                    self._decref_defer(oid)
                 elif oid in wo:
                     wo.discard(oid)
-                    if oid not in self.local_refs:
+                    if not self._ref_held(oid):
                         # never escaped + owner dropped it + no other
                         # pin: the owned value can go
                         freed.append(oid)
@@ -3484,7 +3692,7 @@ class Runtime:
             for oid in borrows or ():
                 if oid not in wb:
                     wb.add(oid)
-                    self.local_refs[oid] += 1
+                    self._incref(oid)
         if freed:
             self.free_objects(freed)
 
@@ -3494,65 +3702,123 @@ class Runtime:
         owner-death object loss stays out of scope) but lose
         attribution."""
         wid = handle.worker_id.binary()
-        with self._lock, self._ref_mu:
+        with self._lock:
             borrows = self._worker_borrows.pop(wid, None)
             self._worker_owned.pop(wid, None)
             if borrows:
                 for oid in borrows:
-                    self.local_refs[oid] -= 1
-                    if self.local_refs[oid] <= 0:
-                        del self.local_refs[oid]
-                        self._deferred_frees.append(oid)
+                    self._decref_defer(oid)
 
     # ----------------------------------------------------- reference counting
+    def _ref_stripe(self, oid: bytes) -> _RefShard:
+        return self._ref_shards[hash(oid) % self._ref_shard_n]
+
+    def _ref_stripes_for(self, oids) -> List[_RefShard]:
+        """Distinct stripes for a batch of oids, in ascending index
+        order — the ONLY sanctioned multi-stripe hold (see __init__)."""
+        idxs = sorted({hash(oid) % self._ref_shard_n for oid in oids})
+        return [self._ref_shards[i] for i in idxs]
+
+    def _incref(self, oid: bytes) -> None:
+        sh = self._ref_stripe(oid)
+        with sh.lock:
+            sh.refs[oid] += 1
+
+    def _decref(self, oid: bytes) -> bool:
+        """Drop one count; True on the zero transition (entry removed,
+        NOT deferred — the caller frees synchronously)."""
+        sh = self._ref_stripe(oid)
+        with sh.lock:
+            sh.refs[oid] -= 1
+            if sh.refs[oid] > 0:
+                return False
+            del sh.refs[oid]
+            return True
+
+    def _decref_defer(self, oid: bytes) -> int:
+        """Drop one count; on the zero transition move the oid into its
+        stripe's deferred-free buffer. Returns that buffer's new length
+        (0 when the count stayed positive)."""
+        sh = self._ref_stripe(oid)
+        with sh.lock:
+            sh.refs[oid] -= 1
+            if sh.refs[oid] > 0:
+                return 0
+            del sh.refs[oid]
+            sh.frees.append(oid)
+            return len(sh.frees)
+
+    def _ref_held(self, oid: bytes) -> bool:
+        sh = self._ref_stripe(oid)
+        with sh.lock:
+            return oid in sh.refs
+
+    @property
+    def local_refs(self) -> Dict[bytes, int]:
+        """Merged snapshot of every stripe's counts (tests/state API —
+        NOT the hot path; internal code reads per-stripe)."""
+        merged: Dict[bytes, int] = {}
+        for sh in self._ref_shards:
+            with sh.lock:
+                merged.update(sh.refs)
+        return merged
+
+    @property
+    def _deferred_frees(self) -> List[bytes]:
+        """Merged snapshot of every stripe's free buffer (tests only)."""
+        out: List[bytes] = []
+        for sh in self._ref_shards:
+            with sh.lock:
+                out.extend(sh.frees)
+        return out
+
     def add_local_ref(self, oid: bytes) -> None:
-        with self._ref_mu:
-            self.local_refs[oid] += 1
+        self._incref(oid)
 
     def remove_local_ref(self, oid: bytes) -> None:
-        # zero-ref frees batch through a deferred buffer the ROUTER pump
-        # drains: a driver dropping a list of refs (every `del refs`
-        # after a bulk get) fires thousands of __del__s back-to-back on
-        # the application thread, and the free pass (store deletes +
-        # task-record prune cascades) was ~60% of that thread's time in
-        # the task hot path. Here we only decrement and buffer; crossing
-        # the batch threshold nudges the router, which frees between
-        # dispatch rounds (_flush_deferred_frees in _pump).
-        with self._ref_mu:
-            self.local_refs[oid] -= 1
-            if self.local_refs[oid] > 0:
-                return
-            del self.local_refs[oid]
-            self._deferred_frees.append(oid)
-            # wake immediately for a DEVICE object (its HBM stays pinned
-            # until the flush — latency there is device memory held
-            # hostage) and at the batch threshold; host-object frees
-            # keep the lazy window and drain on the router's next
-            # natural wakeup. The _device_locations probe is a lock-free
-            # dict read (can't take _lock under _ref_mu); a stale answer
-            # only costs one spurious or slightly-late wakeup.
-            nudge = (oid in self._device_locations
-                     or len(self._deferred_frees) == 128)
-        if nudge:
+        # zero-ref frees batch through per-stripe deferred buffers the
+        # ROUTER pump drains: a driver dropping a list of refs (every
+        # `del refs` after a bulk get) fires thousands of __del__s
+        # back-to-back on the application thread, and the free pass
+        # (store deletes + task-record prune cascades) was ~60% of that
+        # thread's time in the task hot path. Here we only decrement and
+        # buffer; crossing the per-stripe batch threshold nudges the
+        # router, which frees between dispatch rounds
+        # (_flush_deferred_frees in _pump).
+        n = self._decref_defer(oid)
+        if n == 0:
+            return
+        # wake immediately for a DEVICE object (its HBM stays pinned
+        # until the flush — latency there is device memory held
+        # hostage) and at the per-stripe batch threshold; host-object
+        # frees keep the lazy window and drain on the router's next
+        # natural wakeup. The _device_locations probe is a lock-free
+        # dict read; a stale answer only costs one spurious or
+        # slightly-late wakeup.
+        if oid in self._device_locations or n >= 16:
             self._wakeup()
 
-    def _take_deferred_frees_locked(self) -> List[bytes]:  # rmtcheck: holds=_ref_mu
-        """With self._ref_mu held: drain the deferral buffer, SKIPPING
-        any oid that picked up a live reference since its count hit zero
-        (e.g. a cached ref handed out again, a borrowed bare-id re-pinned
-        at submission) — freeing those would drop a value a live handle
+    def _take_deferred_frees(self) -> List[bytes]:
+        """Drain every stripe's deferral buffer, SKIPPING any oid that
+        picked up a live reference since its count hit zero (e.g. a
+        cached ref handed out again, a borrowed bare-id re-pinned at
+        submission) — freeing those would drop a value a live handle
         still expects. The synchronous pre-batching free could never see
-        this because it ran at the zero transition itself."""
-        batch = [oid for oid in self._deferred_frees
-                 if oid not in self.local_refs]
-        self._deferred_frees = []
+        this because it ran at the zero transition itself. One stripe
+        lock at a time; the unlocked emptiness peek is racy but safe
+        (a straggler drains on the next flush)."""
+        batch: List[bytes] = []
+        for sh in self._ref_shards:
+            if not sh.frees:
+                continue
+            with sh.lock:
+                batch.extend(oid for oid in sh.frees
+                             if oid not in sh.refs)
+                sh.frees = []
         return batch
 
     def _flush_deferred_frees(self) -> None:
-        with self._ref_mu:
-            if not self._deferred_frees:
-                return
-            batch = self._take_deferred_frees_locked()
+        batch = self._take_deferred_frees()
         if batch:
             self.free_objects(batch)
 
@@ -3574,11 +3840,17 @@ class Runtime:
                     or not rec.args_released):
                 continue
             rets = rec.spec.return_ids
-            # _ref_mu spans the handle check AND the pops: an app-thread
-            # add_local_ref (a cached ref handed out again) must not
-            # land between "no handle lives" and the future/value drop
-            with self._ref_mu:
-                if any(r in self.local_refs for r in rets):
+            # the returns' stripe locks span the handle check AND the
+            # pops: an app-thread add_local_ref (a cached ref handed out
+            # again) must not land between "no handle lives" and the
+            # future/value drop. Acquired in ascending index order —
+            # this path is serialized by _lock, and single-stripe
+            # holders never wait on a second lock, so no cycle.
+            stripes = self._ref_stripes_for(rets)
+            for sh in stripes:
+                sh.lock.acquire()
+            try:
+                if any(r in self._ref_stripe(r).refs for r in rets):
                     continue  # a handle (or a task's arg pin) lives
                 if any(self._lineage_dependents.get(r, 0) > 0
                        for r in rets):
@@ -3605,10 +3877,19 @@ class Runtime:
                         self._lineage_dependents[a] = n
                     else:
                         self._lineage_dependents.pop(a, None)
-                        # the arg's producer may have been waiting on us
+                        # the arg's producer may have been waiting on
+                        # us. The arg's stripe may not be held here, so
+                        # this is a bare dict read: racy, and only a
+                        # cascade OPPORTUNITY is at stake — a pin that
+                        # lands concurrently re-checks at the top of the
+                        # next iteration under the stripes' locks.
                         ptid = self.lineage.get(a)
-                        if ptid is not None and a not in self.local_refs:
+                        if ptid is not None \
+                                and a not in self._ref_stripe(a).refs:
                             stack.append(ptid)
+            finally:
+                for sh in stripes:
+                    sh.lock.release()
 
     def free_object(self, oid: bytes) -> None:
         self.free_objects((oid,))
@@ -3658,6 +3939,10 @@ class Runtime:
                 nm = self.nodes.get(node_id)
                 if nm and nm.alive:
                     nm.store.delete(oid)
+        if self._wal_enabled:
+            # freed oids leave the sealed WAL too, or a restart would
+            # resurrect values every live handle already dropped
+            self.gcs.wal_del_sealed(oids)
 
     # ------------------------------------------------------ worker requests
     def _serve_worker_request(self, handle: WorkerHandle, msg: dict) -> None:
@@ -3693,6 +3978,11 @@ class Runtime:
                         # owner-release protocol frees/drops it uniformly
                         self._worker_owned.setdefault(
                             handle.worker_id.binary(), set()).add(oid)
+                if self._wal_enabled \
+                        and len(msg["data"]) <= self._wal_max:
+                    # WAL after _lock released, before the reply hands
+                    # the id out (see put_object)
+                    self.gcs.wal_put_sealed(oid, msg["data"])
                 reply["object_id"] = oid
             elif mtype == "device_put":
                 reply["object_id"] = self.reserve_device_put(handle)
@@ -3956,6 +4246,11 @@ class Runtime:
             self.gcs.set_job_state(self.job_id.binary(), "FINISHED")
         except Exception:  # noqa: BLE001
             pass
+        if self.gcs.durable:
+            try:
+                self.gcs.snapshot_directory()  # final directory snapshot
+            except Exception:  # noqa: BLE001
+                pass
         try:
             # detach this cluster's LogStore so later emits in this
             # process buffer for the NEXT cluster instead of landing in

@@ -12,9 +12,13 @@ The ClusterResourceScheduler / policy-set analog (src/ray/raylet/scheduling/):
     initial bundle placement with PACK/SPREAD/STRICT_* policies
     (bundle_scheduling_policy.h:82-109).
 
-Unlike the reference there is no per-node spillback hop (the two-level
-lease protocol, raylet_client.h:398): scheduling is centralized with the
-owner, which is exact — not an approximation — for a single driver.
+The reference's two-level lease protocol (raylet_client.h:398) now has a
+partial analog: LEAF tasks (no placement/affinity constraint, args
+inline) are handed to a node agent's local lease pool and the AGENT
+picks the worker, spilling back to this scheduler when its pool
+saturates (Runtime._try_leaf_place / NodeManager.submit_leaf). Every
+constrained task still takes this centralized pass, which is exact —
+not an approximation — for a single driver.
 """
 
 from __future__ import annotations

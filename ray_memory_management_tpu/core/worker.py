@@ -79,10 +79,31 @@ class _ReplySender:
         self._cond = threading.Condition()
         self._q: deque = deque()  # guarded-by: _cond
         self._thread: Optional[threading.Thread] = None  # guarded-by: _cond
+        self._urgent = False  # an enqueued frame must not wait out the window  # guarded-by: _cond
+        # adaptive flush window: after the first reply of a burst the
+        # drain thread lingers briefly for stragglers, so N back-to-back
+        # completions cost ONE pickle + ONE pipe write (flushing early
+        # at the size cap). Workers receive explicit RMT_* env vars, not
+        # the driver Config — see NodeManager.build_worker_env.
+        try:
+            self._window_s = float(
+                os.environ.get("RMT_REPLY_FLUSH_WINDOW_S", "0.001"))
+        except ValueError:
+            self._window_s = 0.001
+        try:
+            self._flush_max = int(
+                os.environ.get("RMT_REPLY_FLUSH_MAX", "32"))
+        except ValueError:
+            self._flush_max = 32
 
-    def send(self, msg: dict) -> None:
+    def send(self, msg: dict, urgent: bool = False) -> None:
+        """Enqueue one reply. ``urgent`` frames (owner round trips the
+        executor parks on, the registration hello) flush the queue
+        immediately instead of riding out the coalescing window."""
         with self._cond:
             self._q.append(msg)
+            if urgent:
+                self._urgent = True
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._drain_loop, daemon=True,
@@ -123,8 +144,21 @@ class _ReplySender:
             with self._cond:
                 while not self._q:
                     self._cond.wait()
+                if (self._window_s > 0 and not self._urgent
+                        and len(self._q) < self._flush_max):
+                    # linger for the burst's stragglers; wait() drops
+                    # _cond so executor threads keep enqueueing, and an
+                    # urgent send (or the size cap) ends the window early
+                    deadline = time.monotonic() + self._window_s
+                    while (not self._urgent
+                           and len(self._q) < self._flush_max):
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(left)
                 msgs = list(self._q)
                 self._q.clear()
+                self._urgent = False
             payload = msgs[0] if len(msgs) == 1 else {
                 "type": "batch", "msgs": msgs}
             if not self._write(payload):
@@ -336,7 +370,10 @@ class WorkerRuntimeProxy:
             self._events[req_id] = ev
         msg["req_id"] = req_id
         self.head_round_trips += 1
-        self._worker.sender.send(msg)
+        # urgent: this thread is about to PARK on the reply — every
+        # microsecond the request sits in the coalescing window is pure
+        # added round-trip latency
+        self._worker.sender.send(msg, urgent=True)
         # an owner round trip can block on dependencies this worker itself
         # has queued — let the pipeline keep draining while we park
         dispatcher = self._worker.task_dispatcher
@@ -1264,7 +1301,8 @@ class Worker:
         # registration doubles as the ready signal (exec-then-connect
         # handshake; the runtime binds this connection to our WorkerHandle)
         self.sender.send({"type": "ready", "worker_id": self.worker_id,
-                          "node_id": self.node_id, "pid": os.getpid()})
+                          "node_id": self.node_id, "pid": os.getpid()},
+                         urgent=True)
         # a bootstrap message (the reference's dedicated-worker startup
         # token carrying the assigned actor, worker_pool.h:446) was handed
         # to us AT SPAWN — process it without waiting for the owner's
@@ -1309,10 +1347,11 @@ class Worker:
                 daemon=True, name="materialize-device").start()
         elif mtype == "steal":
             stolen = self.task_dispatcher.steal()
+            # urgent: an idle worker elsewhere is waiting on this handback
             self.sender.send({
                 "type": "stolen",
                 "task_ids": [m["task_id"] for m in stolen],
-            })
+            }, urgent=True)
         elif mtype == "free_device":
             self.device_store.delete(msg["object_id"])
             self._demoted_device.discard(msg["object_id"])

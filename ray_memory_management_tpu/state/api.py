@@ -135,8 +135,7 @@ def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "where": "memory_store",
             "node_id": None,
         })
-    with rt.gcs._lock:
-        oids = list(rt.gcs.object_locations)
+    oids = rt.gcs.directory_keys()
     # one batched directory read replaces the old per-(object, node) shm
     # get/release round-trips — for remote stores each of those was an
     # IPC, making the listing O(objects * nodes) remote calls

@@ -36,6 +36,107 @@ def _median_row(rates) -> Dict[str, float]:
             "max": rates[-1], "trials": len(rates)}
 
 
+def run_scale_curve(node_counts=(1, 2, 4, 8), per_node_cpus=2,
+                    n_tasks=2000, n_actors=32, trials=3):
+    """Throughput-vs-node-count curve over VIRTUAL in-process nodes.
+
+    Each point boots a fresh runtime with ``rmt.init(num_nodes=n)`` (n
+    node managers inside one head process, workers as real subprocesses)
+    and measures task and actor-churn throughput. The curve watches the
+    CONTROL plane: with the sharded directory, agent-local leaf
+    scheduling and batched done replies, tasks/s must climb as nodes are
+    added instead of plateauing at the head's single core. Tasks are
+    submitted WITHOUT a scheduling strategy so they stay leaf-eligible
+    and ride the per-node lease pools; actors use SPREAD so 0-CPU probes
+    don't all pack onto node 0 and serialize on one fork path.
+
+    Returns {nodes, many_tasks_per_s: {node_count: rate}, many_actors_per_s,
+    tasks_scaling_1_to_4, actors_scaling_1_to_4, stats} with per-point
+    median/min/max rows under ``stats`` (dict keys are strings so the
+    structure survives a JSON round trip unchanged)."""
+    import ray_memory_management_tpu as rmt
+
+    curve_nodes = list(node_counts)
+    tasks_pts: Dict[str, float] = {}
+    actors_pts: Dict[str, float] = {}
+    stats = {"many_tasks_per_s": {}, "many_actors_per_s": {}}
+    for n in curve_nodes:
+        rt = rmt.init(num_cpus=per_node_cpus, num_nodes=n,
+                      object_store_memory=1 << 30)
+        try:
+            @rmt.remote(max_retries=0)
+            def noop():
+                return b"ok"
+
+            @rmt.remote(num_cpus=0)
+            class Probe:
+                def ready(self):
+                    return b"ok"
+
+            # warm untimed: boot every node's workers and the fork path
+            # once so the timed bursts measure steady state, not zygote
+            # preload (same rationale as run_scale_suite's warm bursts)
+            rmt.get([noop.remote() for _ in range(4 * n * per_node_cpus)],
+                    timeout=300)
+            warm = [Probe.options(scheduling_strategy="SPREAD").remote()
+                    for _ in range(2 * n)]
+            rmt.get([w.ready.remote() for w in warm], timeout=300)
+            for w in warm:
+                rmt.kill(w)
+            time.sleep(0.5)
+
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                refs = [noop.remote() for _ in range(n_tasks)]
+                rmt.get(refs, timeout=600)
+                rates.append(n_tasks / (time.perf_counter() - t0))
+                del refs
+            stats["many_tasks_per_s"][str(n)] = _median_row(rates)
+            tasks_pts[str(n)] = stats["many_tasks_per_s"][str(n)]["median"]
+
+            def _workers_alive() -> int:
+                return sum(len(nm.workers) for nm in rt.nodes.values())
+
+            floor = _workers_alive()
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                actors = [Probe.options(
+                    scheduling_strategy="SPREAD").remote()
+                    for _ in range(n_actors)]
+                rmt.get([a.ready.remote() for a in actors], timeout=600)
+                rates.append(n_actors / (time.perf_counter() - t0))
+                for a in actors:
+                    rmt.kill(a)
+                del actors
+                # bounded drain so kill/reap cleanup doesn't bleed CPU
+                # into the next timed burst
+                deadline = time.monotonic() + 30.0
+                while (_workers_alive() > floor
+                       and time.monotonic() < deadline):
+                    time.sleep(0.2)
+                time.sleep(0.3)
+            stats["many_actors_per_s"][str(n)] = _median_row(rates)
+            actors_pts[str(n)] = stats["many_actors_per_s"][str(n)]["median"]
+        finally:
+            rmt.shutdown()
+
+    out = {
+        "nodes": curve_nodes,
+        "many_tasks_per_s": {k: round(v, 1) for k, v in tasks_pts.items()},
+        "many_actors_per_s": {k: round(v, 1) for k, v in actors_pts.items()},
+        "stats": {m: {k: {kk: round(vv, 2) for kk, vv in row.items()}
+                      for k, row in pts.items()}
+                  for m, pts in stats.items()},
+    }
+    t1, t4 = tasks_pts.get("1"), tasks_pts.get("4")
+    out["tasks_scaling_1_to_4"] = round(t4 / t1, 3) if t1 and t4 else None
+    a1, a4 = actors_pts.get("1"), actors_pts.get("4")
+    out["actors_scaling_1_to_4"] = round(a4 / a1, 3) if a1 and a4 else None
+    return out
+
+
 def run_scale_suite(n_actors: int = 2000, n_tasks: int = 10_000,
                     n_pgs: int = 1000, broadcast_mb: int = 1024,
                     n_agents: int = 4, trials: int = 3):

@@ -556,3 +556,59 @@ def test_bench_detail_snapshot_has_device_section(bench):
         missing = [k for k in bench.REQUIRED_DEVICE_FIELDS
                    if k not in device]
         assert not missing, missing
+
+
+def test_headline_line_carries_scale_curve_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    scale_curve = {
+        "nodes": [1, 2, 4, 8],
+        "many_tasks_per_s": {"1": 2850.4, "2": 3105.2, "4": 3320.8,
+                             "8": 3290.1},
+        "many_actors_per_s": {"1": 3.1, "2": 4.2, "4": 5.0, "8": 4.8},
+        "tasks_scaling_1_to_4": 1.165,
+        "actors_scaling_1_to_4": 1.613,
+        "stats": {"many_tasks_per_s": {
+            str(n): {"median": 1.0, "min": 0.5, "max": 2.0, "trials": 3}
+            for n in (1, 2, 4, 8)}},
+    }
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, scale_curve=scale_curve)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "scale_curve" in line:  # may be popped only by the <1KB guard
+        assert line["scale_curve"]["tasks_per_s"]["4"] == 3320.8
+        assert line["scale_curve"]["tasks_scaling_1_to_4"] == 1.165
+        # per-point keys are strings so the dotted perf-gate lookup
+        # (scale_curve.tasks_per_s.4) resolves after a JSON round trip
+        assert all(isinstance(k, str)
+                   for k in line["scale_curve"]["tasks_per_s"])
+
+
+def test_headline_line_drops_errored_scale_curve(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu,
+                                  scale_curve={"error": "boom"})
+    assert "scale_curve" not in json.loads(payload)
+
+
+@pytest.mark.slow
+def test_scale_curve_required_fields(bench):
+    """A tiny two-point curve run end-to-end: every REQUIRED field
+    present, per-point stats keyed by stringified node count."""
+    from ray_memory_management_tpu.utils.scale_bench import run_scale_curve
+
+    out = run_scale_curve(node_counts=(1, 2), per_node_cpus=1,
+                          n_tasks=100, n_actors=2, trials=1)
+    missing = [k for k in bench.REQUIRED_SCALE_CURVE_FIELDS
+               if k not in out]
+    assert not missing, missing
+    assert out["nodes"] == [1, 2]
+    assert set(out["many_tasks_per_s"]) == {"1", "2"}
+    assert all(v > 0 for v in out["many_tasks_per_s"].values())
+    assert all(v > 0 for v in out["many_actors_per_s"].values())
+    # only 1 and 4-node points define the 1->4 factor; a 2-point run
+    # leaves it None rather than inventing a ratio
+    assert out["tasks_scaling_1_to_4"] is None
+    row = out["stats"]["many_tasks_per_s"]["1"]
+    assert {"median", "min", "max", "trials"} <= set(row)

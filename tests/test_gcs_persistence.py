@@ -67,6 +67,56 @@ def test_kv_survives_head_restart(tmp_path):
     rmt.shutdown()
 
 
+def test_head_restart_mid_traffic_keeps_sealed_objects(tmp_path):
+    """ISSUE 15 durability acceptance: kill the head while traffic is
+    in flight. Every SEALED small object (task returns + puts, whose WAL
+    write precedes future resolution) must be resolvable after restart;
+    creates that never sealed — and sealed values too big for the WAL,
+    whose only holders died with the old process tree — are swept from
+    the restored directory instead of resurfacing as dangling rows."""
+    import time
+
+    from ray_memory_management_tpu.core.object_ref import ObjectRef
+
+    db = str(tmp_path / "gcs.db")
+    rt = _boot(db)
+
+    @rmt.remote(max_retries=0)
+    def produce(i):
+        return ("sealed-%d" % i).encode() * 4
+
+    @rmt.remote(max_retries=0)
+    def crawl():
+        time.sleep(30)
+        return b"never lands"
+
+    refs = [produce.remote(i) for i in range(8)]
+    vals = rmt.get(refs, timeout=120)
+    put_ref = rmt.put(b"small put payload")
+    put_val = rmt.get(put_ref, timeout=60)
+    big_ref = rmt.put(b"x" * (256 * 1024))  # over sealed_wal_max_bytes
+    assert rmt.get(big_ref, timeout=60)
+    slow = crawl.remote()  # still running when the head dies
+    sealed = [(r.binary(), v) for r, v in zip(refs, vals)]
+    sealed.append((put_ref.binary(), put_val))
+    big_oid, slow_oid = big_ref.binary(), slow.binary()
+    rmt.shutdown()  # head goes down mid-traffic, no drain
+
+    rt = _boot(db)
+    try:
+        # sealed values restore from the WAL and resolve as before
+        for oid, val in sealed:
+            assert rmt.get(ObjectRef(oid), timeout=60) == val
+        # the oversized sealed value and the never-sealed return are
+        # swept: their only holders died with the old process tree
+        assert big_oid not in rt.memory_store
+        assert slow_oid not in rt.memory_store
+        assert big_oid not in rt.gcs.directory_keys()
+        assert slow_oid not in rt.gcs.directory_keys()
+    finally:
+        rmt.shutdown()
+
+
 def test_volatile_default_unchanged(tmp_path):
     rt = rmt.init(num_cpus=2)
 
