@@ -592,6 +592,54 @@ def _elastic_suite():
         return {"error": repr(e)}
 
 
+# Serving-data-plane fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): open-loop p50/p99 +
+# SLO-violation curve through the real stack, paged-vs-monolithic KV
+# concurrent-slot capacity at equal HBM budget (ISSUE floor: >= 1.5x),
+# continuous-vs-barrier tokens/s on staggered arrivals, tokens/s/chip,
+# shed counts, and cold-start seconds for init vs shipped weights.
+REQUIRED_SERVE_FIELDS = (
+    "p50_ms", "p99_ms", "slo_ms", "slo_violation_pct", "latency_curve",
+    "offered_rps", "n_requests", "shed_total",
+    "paged_slots", "slab_slots", "paged_slots_ratio", "kv_backpressure",
+    "continuous_tokens_per_s", "barrier_tokens_per_s",
+    "continuous_vs_barrier", "tokens_per_s_per_chip", "n_chips",
+    "cold_start_init_s", "cold_start_shipped_s",
+)
+
+
+def _serve_suite():
+    """Serving data plane (utils/serve_bench.py); fault-isolated so a
+    failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.serve_bench import (
+            run_serve_suite,
+        )
+
+        out = run_serve_suite()
+        print(
+            f"  serve paged KV: {out['paged_slots']} concurrent slots vs "
+            f"{out['slab_slots']} monolithic at equal HBM budget "
+            f"({out['paged_slots_ratio']:.1f}x), "
+            f"{out['tokens_per_s_per_chip']:,.0f} tok/s/chip",
+            file=sys.stderr)
+        print(
+            f"  serve open-loop @ {out['offered_rps']:.0f} rps: "
+            f"p50 {out['p50_ms']:.0f} ms, p99 {out['p99_ms']:.0f} ms, "
+            f"{out['slo_violation_pct']:.1f}% over SLO; continuous vs "
+            f"barrier {out['continuous_vs_barrier']:.2f}x; cold start "
+            f"{out['cold_start_shipped_s']:.2f}s shipped vs "
+            f"{out['cold_start_init_s']:.2f}s init",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_SERVE_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  serve suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -751,6 +799,7 @@ def main() -> None:
     logging_out = _logging_suite()
     profile = _profile_suite()
     elastic = _elastic_suite()
+    serve = _serve_suite()
     scale = _scale_suite()
     scale_curve = _scale_curve_suite()
     tpu = _tpu_suite()
@@ -765,7 +814,7 @@ def main() -> None:
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
               "profile": profile, "elastic": elastic,
-              "metrics": obs_metrics}
+              "serve": serve, "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -777,7 +826,7 @@ def main() -> None:
     for section in ("micro_stats", "scale", "scale_curve", "tpu",
                     "transfer", "compression", "locality", "device",
                     "tracing", "logging", "profile", "elastic",
-                    "metrics"):
+                    "serve", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
@@ -785,13 +834,15 @@ def main() -> None:
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
                         compression, logging=logging_out, device=device,
-                        profile=profile, scale_curve=scale_curve))
+                        profile=profile, scale_curve=scale_curve,
+                        serve=serve))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
-                  device=None, profile=None, scale_curve=None):
+                  device=None, profile=None, scale_curve=None,
+                  serve=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -898,6 +949,17 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             "async_vs_sync_pct": elastic["async_blocking_vs_sync_pct"],
             "recovery_s": elastic["recovery_s"],
         }
+    if serve and "error" not in serve:
+        # the serving-data-plane acceptance numbers: paged-KV concurrent
+        # slots vs the monolithic slab at equal HBM budget (>= 1.5x),
+        # open-loop tail latency, per-chip decode rate, and the
+        # continuous-batching win over the whole-batch barrier
+        line["serve"] = {
+            "p99_ms": serve["p99_ms"],
+            "tokens_per_s_per_chip": serve["tokens_per_s_per_chip"],
+            "paged_slots_ratio": serve["paged_slots_ratio"],
+            "continuous_vs_barrier": serve["continuous_vs_barrier"],
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -920,9 +982,9 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("profile", "compression", "elastic", "logging",
-                  "tracing", "device", "locality", "transfer", "micro",
-                  "scale_curve", "scale"):
+        for k in ("serve", "profile", "compression", "elastic",
+                  "logging", "tracing", "device", "locality", "transfer",
+                  "micro", "scale_curve", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

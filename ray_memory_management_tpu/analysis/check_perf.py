@@ -56,6 +56,12 @@ FIELD_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("scale_curve.tasks_scaling_1_to_4", "up", 0.25),
     ("tpu.train_tokens_per_s", "up", 0.35),
     ("tpu.train_mfu", "up", 0.35),
+    # serving data plane (ISSUE 17): tail latency must not creep, the
+    # paged-KV capacity win and per-chip decode rate must not erode
+    ("serve.p99_ms", "down", 200.0),
+    ("serve.tokens_per_s_per_chip", "up", 0.40),
+    ("serve.paged_slots_ratio", "up", 0.25),
+    ("serve.continuous_vs_barrier", "up", 0.30),
     ("tracing.overhead_pct", "down", 4.0),
     ("logging.overhead_pct", "down", 4.0),
     ("profile.overhead_pct", "down", 4.0),

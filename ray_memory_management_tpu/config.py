@@ -299,6 +299,28 @@ _flag("tpu_visible_chips_env", str, "TPU_VISIBLE_CHIPS",
       "Env var used to scope chips to a leased worker, the TPU analog of "
       "CUDA_VISIBLE_DEVICES handling (_raylet.pyx:563, _private/utils.py:349).")
 
+# --- serve data plane --------------------------------------------------------
+_flag("serve_backpressure_timeout_s", float, 60.0,
+      "How long a Router.assign call waits for a replica slot to drain "
+      "before shedding the request (raises BackpressureTimeout and bumps "
+      "rmt_serve_shed_total{reason=backpressure_timeout}).")
+_flag("kv_page_tokens", int, 64,
+      "KV-cache page size in tokens for the serve engine's paged "
+      "device cache: a slot's KV rows grow in pages of this many "
+      "positions instead of reserving max_seq up front, so HBM held by "
+      "a replica scales with live tokens.")
+_flag("serve_kv_pool_bytes", int, 0,
+      "Per-replica KV page-pool budget in bytes. 0 sizes the pool to "
+      "the monolithic slab's footprint (max_slots x max_seq), so the "
+      "paged engine can never hold more HBM than the slab it replaced; "
+      "exhaustion causes admission backpressure, never an allocation "
+      "failure.")
+_flag("serve_shed_queue_factor", float, 2.0,
+      "HTTP proxy load-shed threshold as a multiple of the deployment's "
+      "total capacity (replicas x max_concurrent_queries): when the "
+      "known queue depth exceeds it the proxy answers 429 instead of "
+      "queueing the request.")
+
 # --- misc --------------------------------------------------------------------
 _flag("memory_monitor_interval_s", float, 0.0,
       "Node OOM-monitor check period (memory_monitor.h analog). 0 "

@@ -258,6 +258,54 @@ DEFS: Dict[str, tuple] = {
         description="Worker-side log batch drain time per flush frame "
                     "(done reply, ticker, or exit flush).",
         boundaries=LATENCY_BOUNDARIES)),
+    # serve data plane (serve/: router, replica, proxy, paged KV engine)
+    "rmt_serve_requests_total": (Counter, dict(
+        description="Requests executed by serve replicas, by deployment "
+                    "and result (ok | error).",
+        tag_keys=("deployment", "result"))),
+    "rmt_serve_request_seconds": (Histogram, dict(
+        description="Replica-side service time per request (queue wait "
+                    "inside the replica included, routing excluded).",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("deployment",))),
+    "rmt_serve_shed_total": (Counter, dict(
+        description="Requests shed instead of queued, by reason: "
+                    "backpressure_timeout (router deadline expired), "
+                    "no_replicas (routing table stayed empty), "
+                    "queue_full (proxy 429 on queue depth past "
+                    "serve_shed_queue_factor x capacity).",
+        tag_keys=("reason",))),
+    "rmt_serve_queue_depth": (Gauge, dict(
+        description="Cluster-wide ongoing-request depth per deployment, "
+                    "from the replica queue-depth snapshots piggybacked "
+                    "on the controller's routing table.",
+        tag_keys=("deployment",))),
+    "rmt_serve_autoscale_errors_total": (Counter, dict(
+        description="Replica metrics fetches that failed during an "
+                    "autoscale pass (previously swallowed silently).")),
+    "rmt_serve_autoscale_decisions_total": (Counter, dict(
+        description="Autoscaling decisions that changed a deployment's "
+                    "target replica count, by direction (up | down).",
+        tag_keys=("direction",))),
+    "rmt_serve_kv_pages_in_use": (Gauge, dict(
+        description="KV-cache pages currently allocated from the serve "
+                    "engine's device page pool (live-token footprint in "
+                    "kv_page_tokens units).")),
+    "rmt_serve_kv_backpressure_total": (Counter, dict(
+        description="Admissions deferred because the KV page pool was "
+                    "exhausted (the request stays queued and admits "
+                    "when a retiring slot frees pages — backpressure, "
+                    "never an allocation failure).")),
+    "rmt_serve_cold_start_seconds": (Histogram, dict(
+        description="Replica model cold-start time, by weight source "
+                    "(init = fresh parameter init, shipped = quantized "
+                    "weights from the movement plane).",
+        boundaries=LATENCY_BOUNDARIES, tag_keys=("source",))),
+    "rmt_serve_replica_placements_total": (Counter, dict(
+        description="Replica actor placements, by mode (tier_affine = "
+                    "soft node affinity toward a holder of the "
+                    "deployment's weights object from the tier-tagged "
+                    "locality directory, default = no hint).",
+        tag_keys=("mode",))),
     # profiling plane (utils/profiler.py)
     "rmt_proc_cpu_seconds_total": (Counter, dict(
         description="Process CPU seconds (user+system) accumulated, by "
@@ -540,6 +588,46 @@ def proc_cpu_seconds() -> Counter:
 
 def proc_rss_bytes() -> Gauge:
     return get("rmt_proc_rss_bytes")
+
+
+def serve_requests() -> Counter:
+    return get("rmt_serve_requests_total")
+
+
+def serve_request_seconds() -> Histogram:
+    return get("rmt_serve_request_seconds")
+
+
+def serve_shed() -> Counter:
+    return get("rmt_serve_shed_total")
+
+
+def serve_queue_depth() -> Gauge:
+    return get("rmt_serve_queue_depth")
+
+
+def serve_autoscale_errors() -> Counter:
+    return get("rmt_serve_autoscale_errors_total")
+
+
+def serve_autoscale_decisions() -> Counter:
+    return get("rmt_serve_autoscale_decisions_total")
+
+
+def serve_kv_pages_in_use() -> Gauge:
+    return get("rmt_serve_kv_pages_in_use")
+
+
+def serve_kv_backpressure() -> Counter:
+    return get("rmt_serve_kv_backpressure_total")
+
+
+def serve_cold_start_seconds() -> Histogram:
+    return get("rmt_serve_cold_start_seconds")
+
+
+def serve_replica_placements() -> Counter:
+    return get("rmt_serve_replica_placements_total")
 
 
 def profile_samples() -> Counter:

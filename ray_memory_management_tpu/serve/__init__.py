@@ -21,5 +21,17 @@ from .deployment import (  # noqa: F401
     Deployment,
     deployment,
 )
-from .handle import DeploymentHandle  # noqa: F401
-from .llm import DynamicBatcher, LLMServer, llm_deployment  # noqa: F401
+from .handle import (  # noqa: F401
+    BackpressureTimeout,
+    DeploymentHandle,
+    NoReplicasError,
+)
+from .kv_cache import KVPagePool  # noqa: F401
+from .llm import (  # noqa: F401
+    ContinuousBatcher,
+    DynamicBatcher,
+    LLMServer,
+    llm_deployment,
+    pack_weights,
+    unpack_weights,
+)

@@ -10,6 +10,16 @@ All methods are async: they run on the controller actor's event loop, so
 state needs no locks and long-poll ``listen`` calls park on awaits
 without holding threads. A background reconcile task converges actual
 replicas toward desired state and applies autoscaling decisions.
+
+The reconcile tick also polls every replica's ``metrics()`` — those
+replies carry each replica's queue depth, which the controller
+piggybacks on its routing-table replies (``get_replicas`` and long-poll
+``listen``, including timeout ticks) so routers can make power-of-two-
+choices decisions against near-real-time load without extra RPCs.
+Scaling decisions are logged, counted
+(``rmt_serve_autoscale_decisions_total{direction}``), and pinned into
+the cluster autoscaler's demand set (``request_resources``) so scale-up
+provisions nodes instead of silently queueing replicas.
 """
 
 from __future__ import annotations
@@ -19,8 +29,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import api
+from ..utils import events, structlog
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+log = structlog.get_logger(__name__)
 
 
 class _DeploymentInfo:
@@ -28,12 +41,14 @@ class _DeploymentInfo:
         self.name = name
         self.cfg = cfg  # func_or_class, init_args/kwargs, num_replicas,
         #                 max_concurrent_queries, user_config, actor_options,
-        #                 autoscaling (dict or None)
+        #                 autoscaling (dict or None), placement_hint
         self.replicas: Dict[str, Any] = {}  # tag -> ActorHandle
         self.version = 0
         self.target_replicas = cfg.get("num_replicas", 1)
         self.deleting = False
         self.next_replica_idx = 0
+        self.queue_depths: Dict[str, int] = {}  # tag -> last reported
+        self.resources_pinned = False
 
 
 class ServeController:
@@ -123,6 +138,7 @@ class ServeController:
             "replicas": dict(info.replicas),
             "max_concurrent_queries": info.cfg.get(
                 "max_concurrent_queries", 100),
+            "queue_depths": dict(info.queue_depths),
         }
 
     async def listen(self, name: str, last_version: int,
@@ -139,14 +155,21 @@ class ServeController:
                 return await self.get_replicas(name)  # deleted
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                # timeout tick still refreshes queue depths: depth moves
+                # every request, versioning it would defeat long-polling
                 return {"version": last_version, "replicas": None,
-                        "timeout": True}
+                        "timeout": True,
+                        "queue_depths": dict(info.queue_depths)
+                        if info is not None else {}}
             ev = self._listeners.setdefault(name, asyncio.Event())
             try:
                 await asyncio.wait_for(ev.wait(), timeout=remaining)
             except asyncio.TimeoutError:
                 pass
-        return {"version": last_version, "replicas": None, "timeout": True}
+        info = self.deployments.get(name)
+        return {"version": last_version, "replicas": None, "timeout": True,
+                "queue_depths": dict(info.queue_depths)
+                if info is not None else {}}
 
     def _bump(self, name: str) -> None:
         info = self.deployments.get(name)
@@ -164,7 +187,7 @@ class ServeController:
                     await self._autoscale(info)
                     await self._reconcile_deployment(info)
             except Exception:
-                pass
+                log.warning("serve reconcile tick failed", exc_info=True)
             await asyncio.sleep(self._autoscale_interval_s)
 
     async def _reconcile_deployment(self, info: _DeploymentInfo) -> None:
@@ -176,6 +199,35 @@ class ServeController:
             tags = list(info.replicas)[: current - target]
             await self._stop_replicas(info, tags)
 
+    @staticmethod
+    def _placement_strategy(info: _DeploymentInfo):
+        """Tier-affine placement: when the deployment carries a
+        ``placement_hint`` (hex object id of e.g. its shipped weights),
+        prefer the node whose DEVICE tier already holds that object —
+        the replica's params materialize over local HBM instead of a
+        cross-node fetch. Soft affinity: a gone node falls back to
+        default placement."""
+        hint = info.cfg.get("placement_hint")
+        if not hint:
+            return None, "default"
+        try:
+            from ..core.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+            from ..state import api as state_api
+
+            rows = state_api.list_objects(
+                filters=[("object_id", "=", hint)])
+            rows.sort(key=lambda r: r.get("tier") != "hbm")  # hbm first
+            for row in rows:
+                node_id = row.get("node_id")
+                if node_id:
+                    return (NodeAffinitySchedulingStrategy(
+                        node_id, soft=True), "tier_affine")
+        except Exception:  # noqa: BLE001 — placement is best-effort
+            pass
+        return None, "default"
+
     async def _start_replicas(self, info: _DeploymentInfo, n: int) -> None:
         from .replica import Replica
 
@@ -183,6 +235,15 @@ class ServeController:
         opts.setdefault("num_cpus", 0)
         opts["max_concurrency"] = max(
             info.cfg.get("max_concurrent_queries", 100), 2)
+        strategy, placement_mode = self._placement_strategy(info)
+        if strategy is not None and "scheduling_strategy" not in opts:
+            opts["scheduling_strategy"] = strategy
+        try:
+            from ..core import metrics_defs as mdefs
+            mdefs.serve_replica_placements().inc(
+                n, tags={"mode": placement_mode})
+        except Exception:  # noqa: BLE001
+            pass
         new_tags = []
         for _ in range(n):
             tag = f"{info.name}#{info.next_replica_idx}"
@@ -235,19 +296,58 @@ class ServeController:
                 pass
 
     # ------------------------------------------------------------ autoscaler
-    async def _autoscale(self, info: _DeploymentInfo) -> None:
-        cfg = info.cfg.get("autoscaling")
-        if not cfg or info.deleting or not info.replicas:
-            return
-        refs = [h.metrics.remote() for h in info.replicas.values()]
-        ongoing = []
-        for r in refs:
+    async def _poll_metrics(self, info: _DeploymentInfo) -> List[int]:
+        """Fetch every replica's queue depth (runs each reconcile tick
+        whether or not autoscaling is on — the depths feed routers' p2c
+        choices via the long-poll channel). Failed fetches are COUNTED
+        and logged, never swallowed into a silently stale table."""
+        if info.deleting or not info.replicas:
+            info.queue_depths = {}
+            return []
+        tagged = [(t, h.metrics.remote())
+                  for t, h in info.replicas.items()]
+        depths: Dict[str, int] = {}
+        ongoing: List[int] = []
+        for tag, ref in tagged:
             try:
-                m = await self._aget(r, timeout=5)
-                ongoing.append(m["num_ongoing_requests"])
+                m = await self._aget(ref, timeout=5)
+                depths[tag] = int(m["num_ongoing_requests"])
+                ongoing.append(depths[tag])
             except Exception:
-                pass
-        if not ongoing:
+                try:
+                    from ..core import metrics_defs as mdefs
+                    mdefs.serve_autoscale_errors().inc()
+                except Exception:  # noqa: BLE001
+                    pass
+                log.warning(
+                    "metrics fetch failed for replica %s of %s",
+                    tag, info.name, exc_info=True)
+        info.queue_depths = depths
+        return ongoing
+
+    def _pin_demand(self, info: _DeploymentInfo, desired: int) -> None:
+        """Feed the scaling decision into the cluster autoscaler's demand
+        set: bumping ``target_replicas`` alone only queues actor creation
+        — ``request_resources`` makes the autoscaler PROVISION nodes for
+        replicas that don't fit the current cluster."""
+        opts = info.cfg.get("actor_options") or {}
+        bundle = {k: float(opts[k])
+                  for k in ("num_cpus", "num_gpus", "num_tpus")
+                  if opts.get(k)}
+        if not bundle:
+            bundle = {"num_cpus": 1.0}
+        try:
+            from ..autoscaler import request_resources
+
+            request_resources([dict(bundle)] * desired)
+            info.resources_pinned = True
+        except Exception:  # noqa: BLE001 — no autoscaler running is fine
+            pass
+
+    async def _autoscale(self, info: _DeploymentInfo) -> None:
+        ongoing = await self._poll_metrics(info)
+        cfg = info.cfg.get("autoscaling")
+        if not cfg or info.deleting or not ongoing:
             return
         avg = sum(ongoing) / len(ongoing)
         target_per = cfg.get("target_num_ongoing_requests_per_replica", 1.0)
@@ -258,15 +358,42 @@ class ServeController:
                 or cfg.get("min_replicas", 1)),
         )
         if desired != info.target_replicas:
+            direction = "up" if desired > info.target_replicas else "down"
+            log.info(
+                "autoscaling %s %s: %d -> %d replicas "
+                "(avg ongoing %.2f, target/replica %.2f)",
+                info.name, direction, info.target_replicas, desired,
+                avg, target_per)
+            events.emit(
+                "SERVE_AUTOSCALE",
+                f"{info.name}: {info.target_replicas} -> {desired} "
+                f"(avg ongoing {avg:.2f})",
+                severity=events.INFO, source="serve")
+            try:
+                from ..core import metrics_defs as mdefs
+                mdefs.serve_autoscale_decisions().inc(
+                    tags={"direction": direction})
+            except Exception:  # noqa: BLE001
+                pass
             info.target_replicas = desired
+            self._pin_demand(info, desired)
 
     async def shutdown(self) -> None:
         self._shutdown = True
+        pinned = any(i.resources_pinned
+                     for i in self.deployments.values())
         for info in list(self.deployments.values()):
             info.deleting = True
             info.target_replicas = 0
             await self._reconcile_deployment(info)
         self.deployments.clear()
+        if pinned:
+            try:
+                from ..autoscaler import request_resources
+
+                request_resources([])
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def get_or_create_controller():

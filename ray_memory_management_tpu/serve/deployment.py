@@ -49,7 +49,8 @@ class Deployment:
                  user_config: Any = None,
                  max_concurrent_queries: int = 100,
                  autoscaling_config: Optional[AutoscalingConfig] = None,
-                 ray_actor_options: Optional[Dict] = None):
+                 ray_actor_options: Optional[Dict] = None,
+                 placement_hint: Optional[str] = None):
         self._func_or_class = func_or_class
         self.name = name
         self.num_replicas = num_replicas
@@ -59,6 +60,9 @@ class Deployment:
         self.max_concurrent_queries = max_concurrent_queries
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = ray_actor_options
+        # hex object id whose holding node/tier new replicas should
+        # prefer (e.g. shipped weights pinned in a device tier)
+        self.placement_hint = placement_hint
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
@@ -67,7 +71,8 @@ class Deployment:
                 user_config: Any = None,
                 max_concurrent_queries: Optional[int] = None,
                 autoscaling_config: Optional[AutoscalingConfig] = None,
-                ray_actor_options: Optional[Dict] = None) -> "Deployment":
+                ray_actor_options: Optional[Dict] = None,
+                placement_hint: Optional[str] = None) -> "Deployment":
         return Deployment(
             self._func_or_class,
             name if name is not None else self.name,
@@ -81,6 +86,8 @@ class Deployment:
             else self.autoscaling_config,
             ray_actor_options if ray_actor_options is not None
             else self.ray_actor_options,
+            placement_hint if placement_hint is not None
+            else self.placement_hint,
         )
 
     def bind(self, *args, **kwargs) -> Application:
@@ -116,6 +123,7 @@ class Deployment:
             "actor_options": self.ray_actor_options,
             "autoscaling": self.autoscaling_config.to_dict()
             if self.autoscaling_config else None,
+            "placement_hint": self.placement_hint,
         }
         if cfg["autoscaling"]:
             # autoscaler owns num_replicas between min and max
@@ -132,7 +140,8 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                user_config: Any = None,
                max_concurrent_queries: int = 100,
                autoscaling_config: Optional[Any] = None,
-               ray_actor_options: Optional[Dict] = None):
+               ray_actor_options: Optional[Dict] = None,
+               placement_hint: Optional[str] = None):
     """``@serve.deployment`` / ``@serve.deployment(num_replicas=...)``."""
     if autoscaling_config is not None and isinstance(
             autoscaling_config, dict):
@@ -149,6 +158,7 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             max_concurrent_queries=max_concurrent_queries,
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
+            placement_hint=placement_hint,
         )
 
     if _func_or_class is not None:

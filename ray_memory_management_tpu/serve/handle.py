@@ -5,10 +5,19 @@ Router/ReplicaSet with in-flight caps (serve/_private/router.py:62,261,298)
 and a LongPollClient keeping the routing table fresh
 (serve/_private/long_poll.py:179).
 
-Router policy: pick the live replica with the fewest locally-tracked
-in-flight requests (power-of-all least-loaded); when every replica is at
-``max_concurrent_queries``, block on wait() until one drains — the
-reference's backpressure behavior.
+Router policy: power-of-two-choices — sample two replicas with open
+slots and take the one with the lower load score, where the score is the
+router's OWN in-flight count plus the replica's last-reported queue
+depth (snapshots the controller piggybacks on its ``metrics()`` poll
+replies and pushes through the long-poll channel, including timeout
+ticks, so depth stays fresh without version churn). Scan-all least-
+loaded degrades at fleet size (every router herds onto the same
+momentarily-idle replica); two random choices keep the max queue within
+O(log log n) of optimal while reading O(1) state. When every replica is
+at ``max_concurrent_queries``, block on wait() until one drains — the
+reference's backpressure behavior — and give up after
+``serve_backpressure_timeout_s`` with a typed, counted error
+(:class:`BackpressureTimeout`, ``rmt_serve_shed_total``).
 """
 
 from __future__ import annotations
@@ -21,6 +30,26 @@ from typing import Any, Dict, List, Optional
 from .. import api
 
 
+class NoReplicasError(RuntimeError):
+    """The routing table stayed empty for the whole backpressure window
+    (deployment deleted, all replicas dead, or never started)."""
+
+
+class BackpressureTimeout(RuntimeError):
+    """Every replica sat at ``max_concurrent_queries`` for the whole
+    backpressure window — the load-shedding signal (HTTP 429 at the
+    proxy)."""
+
+
+def _count_shed(reason: str) -> None:
+    try:
+        from ..core import metrics_defs as mdefs
+
+        mdefs.serve_shed().inc(tags={"reason": reason})
+    except Exception:  # noqa: BLE001 — metrics never fail routing
+        pass
+
+
 class Router:
     def __init__(self, controller, deployment_name: str):
         self._controller = controller
@@ -30,6 +59,7 @@ class Router:
         self._replicas: Dict[str, Any] = {}
         self._max_q = 100
         self._inflight: Dict[str, List[Any]] = {}
+        self._depths: Dict[str, int] = {}  # replica-reported queue depth
         self._stop = threading.Event()
         self._refresh(block=True)
         self._poller = threading.Thread(
@@ -46,13 +76,37 @@ class Router:
             time.sleep(0.05)
             state = api.get(
                 self._controller.get_replicas.remote(self._name), timeout=30)
+        self._apply_state(state)
+
+    def _apply_state(self, state: Dict[str, Any]) -> None:
+        """Install a routing-table snapshot; ``replicas is None`` means a
+        long-poll timeout tick, which still refreshes queue depths (they
+        change every request — bumping the table version for them would
+        defeat long-polling)."""
         with self._lock:
+            depths = state.get("queue_depths")
+            if depths is not None:
+                self._depths = dict(depths)
+            if state.get("replicas") is None:
+                return
             self._version = state["version"]
             self._replicas = state["replicas"] or {}
             self._max_q = state.get("max_concurrent_queries", 100)
             self._inflight = {
                 t: self._inflight.get(t, []) for t in self._replicas
             }
+        self._publish_depth()
+
+    def _publish_depth(self) -> None:
+        try:
+            from ..core import metrics_defs as mdefs
+
+            with self._lock:
+                depth = sum(len(v) for v in self._inflight.values())
+            mdefs.serve_queue_depth().set(
+                float(depth), tags={"deployment": self._name})
+        except Exception:  # noqa: BLE001
+            pass
 
     def _poll_loop(self) -> None:
         """LongPollClient: blocks server-side until the table changes."""
@@ -65,39 +119,49 @@ class Router:
                     return
                 time.sleep(0.5)
                 continue
-            if state.get("replicas") is None:
-                continue  # timeout tick
-            with self._lock:
-                self._version = state["version"]
-                self._replicas = state["replicas"] or {}
-                self._max_q = state.get("max_concurrent_queries", 100)
-                self._inflight = {
-                    t: self._inflight.get(t, []) for t in self._replicas
-                }
+            self._apply_state(state)
 
     def _prune(self) -> None:
         # drop completed refs from in-flight tracking (router.py:298 —
         # the reference decrements on reply callbacks; we poll readiness)
+        # in ONE batched zero-timeout wait across all replicas — the old
+        # per-replica loop paid one runtime round-trip per replica per
+        # assign, which dominated routing cost at fleet size
+        all_refs = [r for refs in self._inflight.values() for r in refs]
+        if not all_refs:
+            return
+        ready, _ = api.wait(all_refs, num_returns=len(all_refs), timeout=0)
+        done = set(ready)
+        if not done:
+            return
         for tag, refs in self._inflight.items():
-            if not refs:
-                continue
-            ready, not_ready = api.wait(
-                refs, num_returns=len(refs), timeout=0)
-            self._inflight[tag] = list(not_ready)
+            self._inflight[tag] = [r for r in refs if r not in done]
+
+    def _score(self, tag: str) -> int:
+        """Load score: locally-tracked in-flight plus the replica's last
+        self-reported queue depth (covers load from OTHER routers)."""
+        return len(self._inflight.get(tag, [])) + self._depths.get(tag, 0)
 
     def assign(self, method: str, args, kwargs):
-        deadline = time.monotonic() + 60
+        from ..config import global_config
+
+        deadline = time.monotonic() + \
+            global_config().serve_backpressure_timeout_s
         while True:
             with self._lock:
                 self._prune()
-                candidates = [
-                    (len(self._inflight.get(t, [])), t, h)
-                    for t, h in self._replicas.items()
+                open_slots = [
+                    (t, h) for t, h in self._replicas.items()
+                    if len(self._inflight.get(t, [])) < self._max_q
                 ]
-                open_slots = [c for c in candidates if c[0] < self._max_q]
                 if open_slots:
-                    open_slots.sort(key=lambda c: (c[0], random.random()))
-                    _, tag, handle = open_slots[0]
+                    # power of two choices over the open slots
+                    picks = random.sample(open_slots, 2) \
+                        if len(open_slots) > 2 else open_slots
+                    tag, handle = min(
+                        picks,
+                        key=lambda th: (self._score(th[0]),
+                                        random.random()))
                     ref = handle.handle_request.remote(method, args, kwargs)
                     self._inflight.setdefault(tag, []).append(ref)
                     return ref
@@ -106,15 +170,40 @@ class Router:
             if not pending:
                 # no replicas yet: wait for the routing table to fill
                 if time.monotonic() > deadline:
-                    raise RuntimeError(
+                    _count_shed("no_replicas")
+                    raise NoReplicasError(
                         f"no replicas available for {self._name}")
                 time.sleep(0.05)
                 continue
             # every replica at max_concurrent_queries: wait for one to drain
             api.wait(pending, num_returns=1, timeout=1.0)
             if time.monotonic() > deadline:
-                raise RuntimeError(
+                _count_shed("backpressure_timeout")
+                raise BackpressureTimeout(
                     f"backpressure timeout routing to {self._name}")
+
+    def queue_depth(self) -> int:
+        """Known outstanding requests for this deployment: the larger of
+        this router's in-flight view and the replicas' self-reported
+        depths (other routers' load)."""
+        with self._lock:
+            local = sum(len(v) for v in self._inflight.values())
+            remote = sum(self._depths.get(t, 0) for t in self._replicas)
+        return max(local, remote)
+
+    def overloaded(self) -> bool:
+        """Proxy-side shed signal: queue depth at or beyond
+        ``serve_shed_queue_factor x replicas x max_concurrent_queries``
+        means a new request would only wait out its whole backpressure
+        window — reject it up front (HTTP 429) instead."""
+        from ..config import global_config
+
+        with self._lock:
+            n = len(self._replicas)
+        if n == 0:
+            return False  # cold table: let assign() wait for replicas
+        cap = global_config().serve_shed_queue_factor * n * self._max_q
+        return self.queue_depth() >= cap
 
     def shutdown(self) -> None:
         self._stop.set()

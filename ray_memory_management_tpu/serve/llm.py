@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..utils import faults
 from .deployment import deployment
 
 
@@ -142,12 +143,29 @@ class ContinuousBatcher:
     only to its own true history with its own rope phases — the padded-
     batch approximation (a short row conditioning on its repeated final
     token) is gone.
+
+    KV memory is PAGED by default (``kv_cache="paged"``): instead of a
+    monolithic ``max_slots x max_seq`` slab pinned forever, each admitted
+    request reserves page-aligned KV capacity for its own lifetime
+    (prompt + budget) from a :class:`~.kv_cache.KVPagePool` of pinned
+    device objects. Between iterations the pool's device store owns every
+    live slot's KV rows; each iteration consumes them (``take`` — a
+    donation read), packs them into one working slab whose sequence
+    capacity is the page-aligned max over LIVE slots (not ``max_seq``),
+    runs the donated compiled step, and pins the surviving rows back.
+    ``_retire`` frees the slot's pages, so a replica's HBM tracks live
+    tokens; pool exhaustion defers admission (backpressure) instead of
+    OOMing. ``kv_cache="slab"`` keeps the old monolithic layout for A/B
+    benchmarking.
     """
 
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  pad_multiple: int = 64, seed: int = 0,
-                 steps_per_iter: int = 8):
+                 steps_per_iter: int = 8,
+                 kv_cache: str = "paged",
+                 kv_page_tokens: Optional[int] = None,
+                 kv_pool_bytes: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -169,8 +187,24 @@ class ContinuousBatcher:
         # steps and finished rows retire within K steps)
         self.steps_per_iter = max(1, min(steps_per_iter, max_new_tokens))
         self._key = jax.random.PRNGKey(seed)
-        self._cache = gpt.init_kv_cache(cfg, max_slots, cfg.max_seq)
-        self._prefill_cache: Dict[int, Any] = {}  # bucket -> compiled fn
+        if kv_cache not in ("paged", "slab"):
+            raise ValueError(f"unknown kv_cache mode: {kv_cache!r}")
+        self.kv_cache_mode = kv_cache
+        if kv_cache == "paged":
+            from ..config import global_config
+            from .kv_cache import KVPagePool
+
+            gcfg = global_config()
+            self.kv_pool: Optional[KVPagePool] = KVPagePool(
+                cfg, max_slots=max_slots,
+                page_tokens=kv_page_tokens or gcfg.kv_page_tokens,
+                pool_bytes=kv_pool_bytes if kv_pool_bytes is not None
+                else gcfg.serve_kv_pool_bytes)
+            self._cache = None
+        else:
+            self.kv_pool = None
+            self._cache = gpt.init_kv_cache(cfg, max_slots, cfg.max_seq)
+        self._prefill_cache: Dict[Any, Any] = {}  # bucket[, cap] -> fn
 
         def _sample(logits, key):
             if self.temperature > 0:
@@ -203,6 +237,8 @@ class ContinuousBatcher:
         self._slot_last = np.ones(max_slots, np.int32)
         self._slot_out: List[List[int]] = [[] for _ in range(max_slots)]
         self._slot_budget = np.zeros(max_slots, np.int32)
+        self._slot_cap = np.zeros(max_slots, np.int32)  # paged: reserved
+        self.kv_backpressure = 0  # admissions deferred on pool exhaustion
 
         self._q: List[_Pending] = []
         self._cond = threading.Condition()
@@ -249,6 +285,26 @@ class ContinuousBatcher:
             p.event.set()
 
     # -- engine side ----------------------------------------------------------
+    def _clip_tokens(self, toks: List[int]) -> List[int]:
+        limit = self.cfg.max_seq - self.max_new_tokens
+        return toks[-limit:]
+
+    def _bucket_for(self, toks: List[int]) -> int:
+        limit = self.cfg.max_seq - self.max_new_tokens
+        bucket = max(self.pad_multiple,
+                     ((len(toks) + self.pad_multiple - 1)
+                      // self.pad_multiple) * self.pad_multiple)
+        return min(bucket, limit)
+
+    def _need_tokens(self, p: _Pending) -> int:
+        """Page-aligned KV capacity one request needs for its whole
+        lifetime: the prefill bucket (whose junk tail must fit) or
+        prompt + token budget, whichever is larger."""
+        toks, budget = p.item
+        toks = self._clip_tokens(list(toks))
+        need = max(self._bucket_for(toks), len(toks) + budget)
+        return min(self.kv_pool.round_tokens(need), self.cfg.max_seq)
+
     def _prefill_fn(self, bucket: int):
         jax, jnp, gpt, cfg = self._jax, self._jnp, self._gpt, self.cfg
         fn = self._prefill_cache.get(bucket)
@@ -276,22 +332,53 @@ class ContinuousBatcher:
         self._prefill_cache[bucket] = fn
         return fn
 
+    def _paged_prefill_fn(self, bucket: int, cap: int):
+        """Prefill into a FRESH single-row cache of seq capacity ``cap``
+        (the slot's page-aligned reservation) — no slab to splice into;
+        the row cache becomes the slot's pooled KV object. Compiled per
+        (bucket, cap) pair; both are page/pad-aligned so the variant set
+        stays small."""
+        jax, jnp, gpt, cfg = self._jax, self._jnp, self._gpt, self.cfg
+        key_ = ("paged", bucket, cap)
+        fn = self._prefill_cache.get(key_)
+        if fn is not None:
+            return fn
+
+        def prefill(params, tokens, true_len, key):
+            row_cache = gpt.init_kv_cache(cfg, 1, cap)
+            logits, row_cache = gpt.forward_with_cache_rows(
+                params, tokens, row_cache, jnp.zeros((1,), jnp.int32), cfg)
+            first = self._sample(logits[0, true_len - 1][None], key)[0]
+            return row_cache, first
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[key_] = fn
+        return fn
+
     def _admit(self, p: _Pending, row: int) -> None:
+        act = faults.fire("serve.admit")
+        if act is not None:
+            if act.mode == "stall":
+                act.sleep()
+            else:  # error/drop: fail ONLY this request, engine keeps going
+                act.raise_()
         np, jnp = self._np, self._jnp
         toks, budget = p.item
-        limit = self.cfg.max_seq - self.max_new_tokens
-        toks = toks[-limit:]
-        bucket = max(self.pad_multiple,
-                     ((len(toks) + self.pad_multiple - 1)
-                      // self.pad_multiple) * self.pad_multiple)
-        bucket = min(bucket, limit)
+        toks = self._clip_tokens(toks)
+        bucket = self._bucket_for(toks)
         arr = np.ones((1, bucket), np.int32)
         arr[0, : len(toks)] = toks  # right-pad junk is invisible: the
         # per-row mask stops at true_len and decode overwrites those slots
         self._key, sub = self._jax.random.split(self._key)
-        self._cache, first = self._prefill_fn(bucket)(
-            self.params, self._cache, jnp.asarray(arr),
-            jnp.int32(row), jnp.int32(len(toks)), sub)
+        if self.kv_pool is not None:
+            cap = int(self._slot_cap[row])  # reserved by the admit gate
+            row_cache, first = self._paged_prefill_fn(bucket, cap)(
+                self.params, jnp.asarray(arr), jnp.int32(len(toks)), sub)
+            self.kv_pool.put_row(row, row_cache)
+        else:
+            self._cache, first = self._prefill_fn(bucket)(
+                self.params, self._cache, jnp.asarray(arr),
+                jnp.int32(row), jnp.int32(len(toks)), sub)
         self._slot_pending[row] = p
         self._slot_offset[row] = len(toks)
         self._slot_last[row] = int(first)
@@ -303,9 +390,98 @@ class ContinuousBatcher:
         self._slot_pending[row] = None
         self._slot_offset[row] = 0
         self._slot_last[row] = 1
+        if self.kv_pool is not None:
+            # pages return to the pool and the slot's KV objects drop out
+            # of the device tier: rmt_device_bytes_pinned falls by this
+            # slot's live footprint, and a queued request can now reserve
+            self.kv_pool.free(row)
+            self._slot_cap[row] = 0
         if p is not None:
             p.result = self._slot_out[row]
             p.event.set()
+
+    def _assemble(self, active: List[int]):
+        """Consume every active slot's pooled KV rows (``take`` — the
+        store drops its reference so the step can DONATE the buffers) and
+        pack them into one working slab whose seq capacity is the page-
+        aligned max over LIVE slots — not ``max_seq``. Batch dim stays
+        ``max_slots`` so the compiled step only re-specializes on S."""
+        jnp, cfg = self._jnp, self.cfg
+        S = max(int(self._slot_cap[r]) for r in active)
+        active_set = set(active)
+        zeros = None
+        parts_k, parts_v = [], []
+        for r in range(self.max_slots):
+            rc = self.kv_pool.take_row(r) if r in active_set else None
+            if rc is None:  # idle slot: a zero row keeps shapes static
+                if zeros is None:
+                    zeros = jnp.zeros(
+                        (cfg.n_layers, 1, cfg.kv_heads, S, cfg.head_dim),
+                        jnp.dtype(cfg.dtype))
+                parts_k.append(zeros)
+                parts_v.append(zeros)
+                continue
+            cap = int(self._slot_cap[r])
+            if cap < S:
+                pad = ((0, 0), (0, 0), (0, 0), (0, S - cap), (0, 0))
+                rc = {"k": jnp.pad(rc["k"], pad),
+                      "v": jnp.pad(rc["v"], pad)}
+            parts_k.append(rc["k"])
+            parts_v.append(rc["v"])
+        return {"k": jnp.concatenate(parts_k, axis=1),
+                "v": jnp.concatenate(parts_v, axis=1)}
+
+    def _disassemble(self, cache, rows: List[int]) -> None:
+        """Slice each surviving slot's reserved capacity back out of the
+        working slab and pin it in the pool; the slab itself is dropped
+        (retired slots' rows simply are not put back — that plus
+        ``_retire``'s free() is how HBM tracks live tokens)."""
+        for r in rows:
+            cap = int(self._slot_cap[r])
+            self.kv_pool.put_row(r, {
+                "k": cache["k"][:, r:r + 1, :, :cap, :],
+                "v": cache["v"][:, r:r + 1, :, :cap, :]})
+
+    def _admit_gate(self) -> List:
+        """Pop admissible queued requests (head-of-line FIFO) into free
+        slots. Paged mode reserves each request's lifetime pages FIRST —
+        a failed reserve defers admission (backpressure) until a retiring
+        slot frees pages, so decode can never OOM mid-request. Caller
+        holds ``_cond``."""
+        admits = []
+        for row in range(self.max_slots):
+            if not self._q:
+                break
+            if self._slot_pending[row] is not None:
+                continue
+            if self.kv_pool is None:
+                admits.append((self._q.pop(0), row))
+                continue
+            p = self._q[0]
+            need = self._need_tokens(p)
+            if self.kv_pool.pages_for(need) > self.kv_pool.capacity_pages:
+                # can never fit even in an empty pool: fail fast instead
+                # of backpressuring forever
+                self._q.pop(0)
+                p.error = RuntimeError(
+                    f"request needs {need} KV tokens "
+                    f"({self.kv_pool.pages_for(need)} pages) but the pool "
+                    f"capacity is {self.kv_pool.capacity_pages} pages")
+                p.event.set()
+                continue
+            if not self.kv_pool.reserve(row, need):
+                # pool exhausted: keep FIFO order, admit nothing past the
+                # head — pages free at the next retire
+                self.kv_backpressure += 1
+                try:
+                    from ..core import metrics_defs as mdefs
+                    mdefs.serve_kv_backpressure().inc()
+                except Exception:  # noqa: BLE001
+                    pass
+                break
+            self._slot_cap[row] = need
+            admits.append((self._q.pop(0), row))
+        return admits
 
     def _loop(self) -> None:
         jnp, np = self._jnp, self._np
@@ -321,17 +497,29 @@ class ContinuousBatcher:
                     victims = [p for p in self._slot_pending
                                if p is not None]
                     self._slot_pending = [None] * self.max_slots
+                    if self.kv_pool is not None:
+                        self.kv_pool.free_all()
+                        self._slot_cap[:] = 0
                     for p in victims:
                         p.error = RuntimeError("engine closed")
                         p.event.set()
                     return
-                admits = []
-                for row in range(self.max_slots):
-                    if self._slot_pending[row] is None and self._q:
-                        admits.append((self._q.pop(0), row))
+                admits = self._admit_gate()
             try:
                 for p, row in admits:
-                    self._admit(p, row)
+                    try:
+                        self._admit(p, row)
+                    except faults.FaultInjected as e:
+                        # injected admit failure takes down ONE request,
+                        # not the engine: release the reservation and
+                        # keep admitting
+                        if self.kv_pool is not None:
+                            self.kv_pool.free(row)
+                            self._slot_cap[row] = 0
+                        self._slot_pending[row] = None
+                        p.error = e
+                        p.event.set()
+                        continue
                     if self._slot_budget[row] <= 0:
                         self._retire(row)  # max_new_tokens == 1
                 active = [r for r in range(self.max_slots)
@@ -339,8 +527,10 @@ class ContinuousBatcher:
                 if not active:
                     continue
                 self._key, sub = self._jax.random.split(self._key)
-                self._cache, toks = self._step(
-                    self.params, self._cache,
+                cache = self._assemble(active) if self.kv_pool is not None \
+                    else self._cache
+                cache, toks = self._step(
+                    self.params, cache,
                     jnp.asarray(self._slot_last),
                     jnp.asarray(self._slot_offset), sub)
                 toks = np.asarray(toks)  # [K, B]
@@ -349,7 +539,7 @@ class ContinuousBatcher:
                     # a row finishing mid-iteration consumes only what its
                     # budget allows; the surplus decoded junk wrote into
                     # its OWN cache rows beyond its end, which the per-row
-                    # mask keeps invisible and the next prefill overwrites
+                    # mask keeps invisible and retire/prefill discards
                     take = min(self.steps_per_iter,
                                int(self._slot_budget[r]))
                     self._slot_out[r].extend(
@@ -359,22 +549,74 @@ class ContinuousBatcher:
                     self._slot_budget[r] -= take
                     if self._slot_budget[r] <= 0:
                         self._retire(r)
+                if self.kv_pool is not None:
+                    self._disassemble(cache, [
+                        r for r in active
+                        if self._slot_pending[r] is not None])
+                else:
+                    self._cache = cache
             except BaseException as e:  # noqa: BLE001 — fail loudly to
                 with self._cond:        # every parked caller, keep serving
                     victims = ([p for p in self._slot_pending
                                 if p is not None] + self._q)
                     self._slot_pending = [None] * self.max_slots
                     self._q.clear()
+                if self.kv_pool is not None:
+                    self.kv_pool.free_all()
+                    self._slot_cap[:] = 0
                 for p in victims:
                     p.error = e
                     p.event.set()
+
+    def kv_stats(self) -> Dict[str, Any]:
+        """Pool occupancy snapshot (paged mode) for metrics/benchmarks."""
+        if self.kv_pool is None:
+            return {"mode": "slab", "kv_backpressure": 0}
+        out = dict(self.kv_pool.stats())
+        out["mode"] = "paged"
+        out["kv_backpressure"] = self.kv_backpressure
+        return out
+
+
+def pack_weights(params, precision: str = "bf16") -> Dict[str, Any]:
+    """Quantize a param tree for the movement plane: per-leaf
+    :func:`~..core.codec.quantize_array` payloads (bf16 ~2x, int8 ~4x
+    smaller than f32), so shipping weights to a cold replica moves a
+    fraction of the bytes a full-precision pickle would. Counted under
+    ``rmt_collective_quantized_ops_total{op="serve.weights"}``."""
+    import jax
+    import numpy as np
+
+    from ..core import codec
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    payloads = [codec.quantize_array(np.asarray(leaf, dtype=np.float32),
+                                     precision) for leaf in leaves]
+    codec.count_quantized_op("serve.weights", precision)
+    return {"treedef": treedef, "leaves": payloads, "p": precision}
+
+
+def unpack_weights(payload: Dict[str, Any]):
+    """Inverse of :func:`pack_weights` — dequantize each leaf to f32 and
+    rebuild the param tree on the replica's device."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import codec
+
+    leaves = [jnp.asarray(codec.dequantize_array(p))
+              for p in payload["leaves"]]
+    return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
 
 
 class LLMServer:
     """Deployment class: KV-cached batched generation on one chip.
 
     ``user_config`` (reconfigure) can retune ``max_new_tokens`` /
-    ``temperature`` without a redeploy."""
+    ``temperature`` without a redeploy. ``weights`` (a
+    :func:`pack_weights` payload) skips the replica-side param init —
+    the cold-start path for scale-up replicas; both paths time their
+    init under ``rmt_serve_cold_start_seconds{source=shipped|init}``."""
 
     def __init__(self, preset: str = "gpt2-small",
                  max_batch_size: int = 8,
@@ -384,7 +626,12 @@ class LLMServer:
                  pad_multiple: int = 64,
                  seed: int = 0,
                  batching: str = "continuous",
-                 steps_per_iter: int = 8):
+                 steps_per_iter: int = 8,
+                 kv_cache: str = "paged",
+                 kv_page_tokens: Optional[int] = None,
+                 kv_pool_bytes: Optional[int] = None,
+                 weights: Optional[Dict[str, Any]] = None):
+        t0 = time.monotonic()
         import jax
 
         from ..models import gpt
@@ -396,7 +643,13 @@ class LLMServer:
                 f"{pad_multiple}-token prompt bucket within the model's "
                 f"max_seq={self.cfg.max_seq}")
         self.gpt = gpt
-        self.params = gpt.init_params(jax.random.PRNGKey(seed), self.cfg)
+        if weights is not None:
+            self.params = unpack_weights(weights)
+            cold_source = "shipped"
+        else:
+            self.params = gpt.init_params(
+                jax.random.PRNGKey(seed), self.cfg)
+            cold_source = "init"
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.pad_multiple = pad_multiple
@@ -406,13 +659,17 @@ class LLMServer:
         self._stats = {"requests": 0, "batches": 0, "generated_tokens": 0}
         self.batching = batching
         self.steps_per_iter = steps_per_iter
+        self.kv_cache = kv_cache
+        self.kv_page_tokens = kv_page_tokens
+        self.kv_pool_bytes = kv_pool_bytes
         if batching == "continuous":
             # decode-step-granular join/leave + exact per-row positions
             self._engine = ContinuousBatcher(
                 self.params, self.cfg, max_slots=max_batch_size,
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 pad_multiple=pad_multiple, seed=seed + 1,
-                steps_per_iter=steps_per_iter)
+                steps_per_iter=steps_per_iter, kv_cache=kv_cache,
+                kv_page_tokens=kv_page_tokens, kv_pool_bytes=kv_pool_bytes)
             self._batcher = None
         elif batching == "barrier":
             # legacy whole-batch mode (kept for A/B benchmarking)
@@ -422,6 +679,12 @@ class LLMServer:
                 batch_wait_timeout_s=batch_wait_timeout_s)
         else:
             raise ValueError(f"unknown batching mode: {batching!r}")
+        try:
+            from ..core import metrics_defs as mdefs
+            mdefs.serve_cold_start_seconds().observe(
+                time.monotonic() - t0, tags={"source": cold_source})
+        except Exception:  # noqa: BLE001 — metrics never fail init
+            pass
 
     # -- config ---------------------------------------------------------------
     def reconfigure(self, user_config: Optional[dict]) -> None:
@@ -448,7 +711,10 @@ class LLMServer:
                 self.params, self.cfg, max_slots=self.max_batch_size,
                 max_new_tokens=new_tokens, temperature=new_temp,
                 pad_multiple=self.pad_multiple, seed=self.seed + 1,
-                steps_per_iter=self.steps_per_iter)
+                steps_per_iter=self.steps_per_iter,
+                kv_cache=self.kv_cache,
+                kv_page_tokens=self.kv_page_tokens,
+                kv_pool_bytes=self.kv_pool_bytes)
             old.close()
 
     # -- request surface ------------------------------------------------------
@@ -487,7 +753,10 @@ class LLMServer:
         return self._batcher.submit(list(tokens))
 
     def stats(self) -> dict:
-        return dict(self._stats)
+        out = dict(self._stats)
+        if self._engine is not None:
+            out["kv"] = self._engine.kv_stats()
+        return out
 
     # -- batched model call ---------------------------------------------------
     def _run_batch(self, prompts: List[List[int]]) -> List[List[int]]:
@@ -537,7 +806,8 @@ class LLMServer:
 
 def llm_deployment(preset: str = "gpt2-small",
                    ray_actor_options: Optional[dict] = None,
-                   max_concurrent_queries: int = 64, **kwargs):
+                   max_concurrent_queries: int = 64,
+                   ship_weights: Optional[str] = None, **kwargs):
     """A ready-to-run Application serving ``preset``:
 
         import ray_memory_management_tpu.serve as serve
@@ -546,12 +816,37 @@ def llm_deployment(preset: str = "gpt2-small",
 
     On a TPU host pass ``ray_actor_options={"num_tpus": 1}`` so the
     replica takes a chip lease (TPU_VISIBLE_CHIPS isolation) and the
-    decode program runs on the chip."""
+    decode program runs on the chip.
+
+    ``ship_weights="bf16"|"int8"`` initializes params ONCE on the driver
+    and ships them quantized to every replica (:func:`pack_weights` over
+    the movement-plane codec) instead of each replica re-initializing —
+    the scale-up cold-start path. The payload is also put into the object
+    store so the controller can place new replicas near the tier holding
+    it (the ``placement_hint`` in the deployment config)."""
+    placement_hint = None
+    if ship_weights:
+        import jax
+
+        from ..models import gpt
+
+        cfg = gpt.PRESETS[preset]
+        seed = kwargs.get("seed", 0)
+        params = gpt.init_params(jax.random.PRNGKey(seed), cfg)
+        kwargs["weights"] = pack_weights(params, precision=ship_weights)
+        try:
+            from .. import api as core_api
+
+            placement_hint = core_api.put(kwargs["weights"]).hex()
+        except Exception:  # noqa: BLE001 — the hint is best-effort; a
+            placement_hint = None  # driver without a running runtime
+            # still gets weights shipped via the deployment config
     return deployment(
         LLMServer, name="LLM", ray_actor_options=ray_actor_options,
         max_concurrent_queries=max_concurrent_queries,
+        placement_hint=placement_hint,
     ).bind(preset=preset, **kwargs)
 
 
 __all__ = ["ContinuousBatcher", "DynamicBatcher", "LLMServer",
-           "llm_deployment"]
+           "llm_deployment", "pack_weights", "unpack_weights"]

@@ -53,18 +53,40 @@ class Replica:
         fn(user_config)
 
     def handle_request(self, method: str, args, kwargs) -> Any:
+        from ..core import metrics_defs as mdefs
+        from ..utils import faults
+
+        t0 = time.monotonic()
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        result = "ok"
         try:
+            act = faults.fire("replica.exec")
+            if act is not None:
+                if act.mode == "stall":  # inflates service time: the
+                    act.sleep()          # p99/SLO-attribution test site
+                else:  # error/drop surface to the caller's api.get
+                    act.raise_()
             if method in ("__call__", None):
                 target = self.callable
             else:
                 target = getattr(self.callable, method)
             return target(*args, **kwargs)
+        except BaseException:
+            result = "error"
+            raise
         finally:
             with self._lock:
                 self._ongoing -= 1
+            try:
+                mdefs.serve_requests().inc(tags={
+                    "deployment": self.deployment_name, "result": result})
+                mdefs.serve_request_seconds().observe(
+                    time.monotonic() - t0,
+                    tags={"deployment": self.deployment_name})
+            except Exception:  # noqa: BLE001 — metrics never fail serving
+                pass
 
     def metrics(self) -> dict:
         with self._lock:

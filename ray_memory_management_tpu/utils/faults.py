@@ -29,6 +29,14 @@ control planes::
                        an injected error defers the eviction — the object
                        stays device-resident and readable (pressure causes
                        slowness, never loss)
+    serve.admit        serve-engine slot admission     (error/stall/drop):
+                       an injected error fails ONLY the request being
+                       admitted (the engine keeps serving); stall delays
+                       the admission, exercising queue backpressure
+    replica.exec       serve replica request execution (error/stall/drop):
+                       error/drop raise out of handle_request (the
+                       caller's ref resolves to the failure); stall
+                       inflates service time, exercising shed paths
 
 Each site × mode carries a probability, an optional activation offset
 (``after``: skip the first N hits) and budget (``max``: stop after N
@@ -71,6 +79,7 @@ SITES = (
     "spill.write", "spill.read", "control.dispatch", "worker.exec",
     "checkpoint.save", "checkpoint.restore",
     "device.materialize", "device.evict",
+    "serve.admit", "replica.exec",
 )
 
 

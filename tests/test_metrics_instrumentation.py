@@ -242,6 +242,60 @@ class TestPrometheusExposition:
             "rmt_device_zero_copy_hits_total").series().values())
         assert after == before + 2
 
+    def test_serve_series_in_exposition(self):
+        """Golden coverage for the serving data plane's series: request
+        counter/latency, the shed counter (tagged by reason), the
+        queue-depth gauge, autoscale error/decision counters, the paged
+        KV gauges, cold-start latency, and placement-mode counter must
+        all surface in the exposition once they have moved."""
+        tagged_counters = {
+            "rmt_serve_requests_total": {"deployment": "d", "result": "ok"},
+            "rmt_serve_shed_total": {"reason": "backpressure_timeout"},
+            "rmt_serve_autoscale_decisions_total": {"direction": "up"},
+            "rmt_serve_replica_placements_total": {"mode": "tier_affine"},
+        }
+        for name, tags in tagged_counters.items():
+            assert name in mdefs.DEFS, name
+            mdefs.get(name).inc(1, tags=tags)
+        assert "rmt_serve_autoscale_errors_total" in mdefs.DEFS
+        mdefs.serve_autoscale_errors().inc(1)
+        assert "rmt_serve_kv_backpressure_total" in mdefs.DEFS
+        mdefs.serve_kv_backpressure().inc(1)
+        gauges = ("rmt_serve_kv_pages_in_use",)
+        for name in gauges:
+            assert name in mdefs.DEFS, name
+            mdefs.get(name).set(5.0)
+        mdefs.serve_queue_depth().set(2.0, tags={"deployment": "d"})
+        mdefs.serve_request_seconds().observe(
+            0.05, tags={"deployment": "d"})
+        mdefs.serve_cold_start_seconds().observe(
+            1.5, tags={"source": "shipped"})
+        text = metrics.export_prometheus()
+        lines = text.splitlines()
+        for name in tagged_counters:
+            assert f"# TYPE {name} counter" in lines, name
+        assert 'rmt_serve_requests_total{deployment="d",result="ok"}' \
+            in text
+        assert 'rmt_serve_shed_total{reason="backpressure_timeout"}' \
+            in text
+        assert "# TYPE rmt_serve_kv_pages_in_use gauge" in lines
+        assert "rmt_serve_kv_pages_in_use 5.0" in lines
+        assert 'rmt_serve_queue_depth{deployment="d"} 2.0' in text
+        assert "# TYPE rmt_serve_request_seconds histogram" in lines
+        assert any(line.startswith("rmt_serve_request_seconds_count")
+                   for line in lines)
+        assert "# TYPE rmt_serve_cold_start_seconds histogram" in lines
+        assert any(
+            line.startswith('rmt_serve_cold_start_seconds_count') and
+            'source="shipped"' in line for line in lines)
+        # the accessors alias the registered instruments' storage
+        before = sum(mdefs.get(
+            "rmt_serve_kv_backpressure_total").series().values())
+        mdefs.serve_kv_backpressure().inc(2)
+        after = sum(mdefs.get(
+            "rmt_serve_kv_backpressure_total").series().values())
+        assert after == before + 2
+
     def test_canonical_defs_construct(self):
         """Every declared instrument is constructible and re-entrant
         (aliases prior storage instead of shadowing it)."""

@@ -1,0 +1,301 @@
+"""Serving data plane: paged KV-cache accounting, admission backpressure,
+load shedding, trace propagation, and the serve fault matrix.
+
+The paged engine's memory contract is tested at the accounting layer
+(pages and pinned device bytes move with admit/retire, exhaustion defers
+admission instead of OOMing) and at the routing layer (typed, counted
+shed errors; proxy 429s; every HTTP response carries the root trace id).
+Fault-matrix entries: ``serve.admit`` errors fail ONLY the admitted
+request, ``replica.exec`` errors surface to the caller — the engine and
+the replica keep serving afterwards.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_memory_management_tpu as rmt
+from ray_memory_management_tpu import serve
+from ray_memory_management_tpu.config import Config, global_config
+from ray_memory_management_tpu.core import metrics_defs as mdefs
+from ray_memory_management_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    yield
+    os.environ.pop("RMT_fault_injection_spec", None)
+    os.environ.pop("RMT_fault_injection_seed", None)
+    faults.reset()
+
+
+@pytest.fixture
+def engine_setup():
+    import jax
+
+    from ray_memory_management_tpu.models import gpt
+
+    cfg = gpt.TransformerConfig(vocab_size=128, n_layers=2, n_heads=2,
+                                d_model=32, max_seq=128)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    yield gpt, cfg, params
+
+
+# --- paged KV accounting -----------------------------------------------------
+
+class TestPagedKV:
+    def test_retire_frees_pages_and_pinned_bytes(self, engine_setup):
+        """The headline memory contract: a slot's KV pages are pinned
+        device objects while the request lives, and BOTH gauges
+        (rmt_device_bytes_pinned, rmt_serve_kv_pages_in_use) fall back
+        to zero at retire — HBM tracks live tokens, not max_slots x
+        max_seq. Driven directly (engine thread stopped) so admit/retire
+        bracket the assertions deterministically."""
+        from ray_memory_management_tpu.serve import llm as llm_mod
+
+        gpt, cfg, params = engine_setup
+        eng = llm_mod.ContinuousBatcher(
+            params, cfg, max_slots=2, max_new_tokens=4, pad_multiple=8,
+            kv_cache="paged", kv_page_tokens=16)
+        eng.close()
+        eng._thread.join(30)
+        assert not eng._thread.is_alive()
+
+        p = llm_mod._Pending(([5, 9, 17, 3], 4))
+        need = eng._need_tokens(p)
+        assert eng.kv_pool.reserve(0, need)
+        eng._slot_cap[0] = need
+        eng._admit(p, 0)
+
+        assert eng.kv_pool.pages_in_use == eng.kv_pool.pages_for(need)
+        live_bytes = eng.kv_pool.store.total_bytes()
+        assert live_bytes > 0
+        assert mdefs.device_bytes_pinned().get() == float(live_bytes)
+        assert mdefs.serve_kv_pages_in_use().get() == \
+            float(eng.kv_pool.pages_for(need))
+
+        eng._retire(0)
+        assert p.event.is_set() and p.result  # request completed
+        assert eng.kv_pool.pages_in_use == 0
+        assert eng.kv_pool.store.total_bytes() == 0
+        assert mdefs.device_bytes_pinned().get() == 0.0
+        assert mdefs.serve_kv_pages_in_use().get() == 0.0
+
+    def test_pool_exhaustion_backpressures_never_fails(self, engine_setup):
+        """More concurrent requests than the page pool fits: admissions
+        DEFER (kv_backpressure counts them) and every request still
+        completes exactly — exhaustion is queueing, never an allocation
+        failure."""
+        import numpy as np
+
+        from ray_memory_management_tpu.serve.kv_cache import row_token_bytes
+        from ray_memory_management_tpu.serve.llm import ContinuousBatcher
+
+        gpt, cfg, params = engine_setup
+        # room for exactly 2 one-page reservations; 4 slots want pages
+        pool_bytes = 2 * 16 * row_token_bytes(cfg)
+        eng = ContinuousBatcher(
+            params, cfg, max_slots=4, max_new_tokens=8, pad_multiple=8,
+            steps_per_iter=4, kv_cache="paged", kv_page_tokens=16,
+            kv_pool_bytes=pool_bytes)
+        try:
+            prompts = [[2 + i, 5, 7, 11] for i in range(6)]
+            res = [None] * 6
+
+            def go(i):
+                res[i] = eng.submit(prompts[i])
+
+            ts = [threading.Thread(target=go, args=(i,)) for i in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(300)
+            assert all(r is not None for r in res)
+            for i, prompt in enumerate(prompts):
+                ref = np.asarray(gpt.generate(
+                    params, cfg, np.asarray([prompt], np.int32), steps=8))
+                assert res[i] == ref[0, len(prompt):].tolist(), i
+            assert eng.kv_backpressure >= 1  # the pool really saturated
+            assert eng.kv_pool.pages_in_use == 0  # all freed at retire
+        finally:
+            eng.close()
+
+    def test_impossible_request_fails_fast_not_forever(self, engine_setup):
+        """A request that cannot fit even an EMPTY pool must fail with a
+        descriptive error immediately — backpressuring it would spin
+        forever with no retiring slot to free pages."""
+        from ray_memory_management_tpu.serve.kv_cache import row_token_bytes
+        from ray_memory_management_tpu.serve.llm import ContinuousBatcher
+
+        gpt, cfg, params = engine_setup
+        eng = ContinuousBatcher(
+            params, cfg, max_slots=2, max_new_tokens=8, pad_multiple=8,
+            kv_cache="paged", kv_page_tokens=16,
+            kv_pool_bytes=16 * row_token_bytes(cfg))  # one page total
+        try:
+            with pytest.raises(RuntimeError, match="pool capacity"):
+                eng.submit(list(range(2, 32)), timeout=30)
+        finally:
+            eng.close()
+
+
+# --- config knob + typed shed errors -----------------------------------------
+
+def test_backpressure_timeout_knob_registered():
+    assert Config().serve_backpressure_timeout_s == 60.0
+    assert Config(serve_backpressure_timeout_s=3.0) \
+        .serve_backpressure_timeout_s == 3.0
+    os.environ["RMT_serve_backpressure_timeout_s"] = "7.5"
+    try:
+        assert Config().serve_backpressure_timeout_s == 7.5
+    finally:
+        os.environ.pop("RMT_serve_backpressure_timeout_s")
+
+
+def test_backpressure_timeout_typed_and_counted(rmt_start_regular,
+                                                monkeypatch):
+    """Routing past a saturated deployment raises the TYPED
+    BackpressureTimeout (not a bare RuntimeError) after
+    serve_backpressure_timeout_s, and counts the shed by reason."""
+    from ray_memory_management_tpu.serve.handle import BackpressureTimeout
+
+    serve.start(http_port=None)
+    try:
+        @serve.deployment(max_concurrent_queries=1)
+        def snooze(x=None):
+            time.sleep(2.5)
+            return "ok"
+
+        h = serve.run(snooze)
+        monkeypatch.setattr(global_config(),
+                            "serve_backpressure_timeout_s", 0.5)
+        slow = threading.Thread(
+            target=lambda: rmt.get(h.remote(1), timeout=60), daemon=True)
+        slow.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:  # wait until it holds the slot
+            if h._router.queue_depth() >= 1:
+                break
+            time.sleep(0.02)
+        before = mdefs.serve_shed().get(tags={"reason":
+                                              "backpressure_timeout"})
+        with pytest.raises(BackpressureTimeout,
+                           match="backpressure timeout routing to"):
+            h.remote(2)
+        assert mdefs.serve_shed().get(
+            tags={"reason": "backpressure_timeout"}) == before + 1
+        slow.join(60)
+    finally:
+        serve.shutdown()
+
+
+def test_http_sheds_429_with_trace_id(rmt_start_regular):
+    """HTTP ingress under saturation: the overflow request gets 429 (a
+    'retry later', not a 500), and EVERY response — shed or served —
+    carries the root x-rmt-trace-id header that stitches the
+    proxy→router→replica spans together."""
+    from ray_memory_management_tpu.serve.api import _ctrl
+    from ray_memory_management_tpu.serve.http_proxy import start_proxy
+
+    os.environ["RMT_serve_backpressure_timeout_s"] = "1.0"
+    from ray_memory_management_tpu import config as cfgmod
+    cfgmod.set_global_config(Config())
+    serve.start(http_port=0)
+    try:
+        @serve.deployment(max_concurrent_queries=1)
+        def plod(x=None):
+            time.sleep(3.0)
+            return {"ok": True}
+
+        serve.run(plod)
+        port = start_proxy(_ctrl(), 0)
+        results = {}
+
+        def first():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/plod",
+                data=json.dumps(1).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                results["status"] = resp.status
+                results["trace"] = resp.headers.get("x-rmt-trace-id")
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        time.sleep(0.8)  # first request is mid-service, slot held
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/plod",
+            data=json.dumps(2).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req2, timeout=60)
+        assert exc.value.code == 429
+        shed_trace = exc.value.headers.get("x-rmt-trace-id")
+        assert shed_trace and int(shed_trace, 16) >= 0  # hex trace id
+        t.join(60)
+        assert results.get("status") == 200
+        served_trace = results.get("trace")
+        assert served_trace and int(served_trace, 16) >= 0
+        assert served_trace != shed_trace  # one root trace per request
+    finally:
+        serve.shutdown()
+        os.environ.pop("RMT_serve_backpressure_timeout_s", None)
+        cfgmod.set_global_config(Config())
+
+
+# --- serve fault matrix ------------------------------------------------------
+
+def test_admit_fault_fails_only_that_request(engine_setup):
+    """An injected serve.admit error fails ONLY the request being
+    admitted (its page reservation rolls back); the engine thread
+    survives and serves the next request exactly."""
+    import numpy as np
+
+    from ray_memory_management_tpu.serve.llm import ContinuousBatcher
+
+    gpt, cfg, params = engine_setup
+    faults.configure("serve.admit:error:max=1", seed=3)
+    eng = ContinuousBatcher(params, cfg, max_slots=2, max_new_tokens=4,
+                            pad_multiple=8, kv_page_tokens=16)
+    try:
+        with pytest.raises(faults.FaultInjected):
+            eng.submit([5, 9, 17, 3], timeout=60)
+        assert eng.kv_pool.pages_in_use == 0  # reservation rolled back
+        out = eng.submit([5, 9, 17, 3], timeout=120)
+        ref = np.asarray(gpt.generate(
+            params, cfg, np.asarray([[5, 9, 17, 3]], np.int32), steps=4))
+        assert out == ref[0, 4:].tolist()
+        assert mdefs.faults_injected().get(
+            tags={"site": "serve.admit", "mode": "error"}) >= 1
+    finally:
+        eng.close()
+
+
+def test_replica_exec_fault_surfaces_and_replica_survives():
+    """An injected replica.exec error surfaces to the caller as a task
+    error (propagated via the env spec — the child-process path); the
+    replica is NOT torn down and the next request succeeds."""
+    os.environ["RMT_fault_injection_spec"] = "replica.exec:error:max=1"
+    os.environ["RMT_fault_injection_seed"] = "17"
+    faults.reset()  # in-process plane re-discovers the env spec too
+    rmt.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        serve.start(http_port=None)
+        try:
+            @serve.deployment
+            def echo(x):
+                return {"x": x}
+
+            h = serve.run(echo)
+            with pytest.raises(Exception, match="injected"):
+                rmt.get(h.remote(1), timeout=60)
+            assert rmt.get(h.remote(2), timeout=60) == {"x": 2}
+        finally:
+            serve.shutdown()
+    finally:
+        rmt.shutdown()
