@@ -640,6 +640,52 @@ def _serve_suite():
         return {"error": repr(e)}
 
 
+# Multi-tenant job-plane fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): submit-path tasks/s
+# with one ledger vs four quota'd jobs and the overhead between them,
+# job-death sweep latency at 100/1000 owned objects, and the 4-driver
+# churn soak's aggregate rate plus its leak probes (directory rows and
+# device bytes left behind by dead jobs — both must be zero).
+REQUIRED_JOB_FIELDS = (
+    "single_job_tasks_per_s", "multi_job_tasks_per_s",
+    "isolation_overhead_pct", "sweep_ms_100", "sweep_ms_1000",
+    "sweep_leaked_rows", "churn_tasks_per_s", "churn_jobs",
+    "churn_kills", "churn_leaked_rows", "churn_leaked_device_bytes",
+)
+
+
+def _jobs_suite():
+    """Multi-tenant job plane (utils/job_plane_bench.py); fault-isolated
+    so a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.job_plane_bench import (
+            run_job_plane_suite,
+        )
+
+        out = run_job_plane_suite()
+        print(
+            f"  jobs isolation: {out['multi_job_tasks_per_s']:,.0f} "
+            f"tasks/s across 4 quota'd jobs vs "
+            f"{out['single_job_tasks_per_s']:,.0f} single-job "
+            f"({out['isolation_overhead_pct']:+.1f}% overhead)",
+            file=sys.stderr)
+        print(
+            f"  jobs sweep: {out['sweep_ms_100']:.1f} ms @ 100 objects, "
+            f"{out['sweep_ms_1000']:.1f} ms @ 1000; churn soak "
+            f"{out['churn_tasks_per_s']:,.0f} tasks/s over "
+            f"{out['churn_jobs']} jobs ({out['churn_kills']} killed), "
+            f"leaks: {out['churn_leaked_rows']} rows / "
+            f"{out['churn_leaked_device_bytes']} device bytes",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_JOB_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  jobs suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _scale_suite():
     """Scalability rows (BASELINE.md second table) against real agent
     processes; fault-isolated so a failure still reports the rest."""
@@ -800,6 +846,7 @@ def main() -> None:
     profile = _profile_suite()
     elastic = _elastic_suite()
     serve = _serve_suite()
+    jobs = _jobs_suite()
     scale = _scale_suite()
     scale_curve = _scale_curve_suite()
     tpu = _tpu_suite()
@@ -814,7 +861,7 @@ def main() -> None:
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
               "profile": profile, "elastic": elastic,
-              "serve": serve, "metrics": obs_metrics}
+              "serve": serve, "jobs": jobs, "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
@@ -826,7 +873,7 @@ def main() -> None:
     for section in ("micro_stats", "scale", "scale_curve", "tpu",
                     "transfer", "compression", "locality", "device",
                     "tracing", "logging", "profile", "elastic",
-                    "serve", "metrics"):
+                    "serve", "jobs", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
                 section: detail[section]}}))
@@ -835,14 +882,14 @@ def main() -> None:
                         tpu, transfer, locality, tracing, elastic,
                         compression, logging=logging_out, device=device,
                         profile=profile, scale_curve=scale_curve,
-                        serve=serve))
+                        serve=serve, jobs=jobs))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
                   device=None, profile=None, scale_curve=None,
-                  serve=None):
+                  serve=None, jobs=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -960,6 +1007,17 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             "paged_slots_ratio": serve["paged_slots_ratio"],
             "continuous_vs_barrier": serve["continuous_vs_barrier"],
         }
+    if jobs and "error" not in jobs:
+        # the job-plane acceptance numbers: multi-tenant submit overhead
+        # (quota admission + fair ordering), sweep latency at 1000
+        # objects, churn-soak rate, and the leak probes (must stay 0)
+        line["jobs"] = {
+            "isolation_overhead_pct": jobs["isolation_overhead_pct"],
+            "sweep_ms_1000": jobs["sweep_ms_1000"],
+            "churn_tasks_per_s": jobs["churn_tasks_per_s"],
+            "churn_leaks": jobs["churn_leaked_rows"]
+            + jobs["churn_leaked_device_bytes"],
+        }
     if tpu:
         if "error" in tpu:
             line["tpu"] = {"error": tpu["error"][:120]}
@@ -982,7 +1040,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("serve", "profile", "compression", "elastic",
+        for k in ("jobs", "serve", "profile", "compression", "elastic",
                   "logging", "tracing", "device", "locality", "transfer",
                   "micro", "scale_curve", "scale"):
             line.pop(k, None)

@@ -62,6 +62,13 @@ FIELD_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("serve.tokens_per_s_per_chip", "up", 0.40),
     ("serve.paged_slots_ratio", "up", 0.25),
     ("serve.continuous_vs_barrier", "up", 0.30),
+    # multi-tenant job plane (ISSUE 18): the quota/attribution machinery
+    # must not tax the submit hot path (overhead is a percentage, so the
+    # band is absolute points), sweeps must stay milliseconds-fast, and
+    # the churn soak's aggregate rate must not collapse
+    ("jobs.isolation_overhead_pct", "down", 10.0),
+    ("jobs.churn_tasks_per_s", "up", 0.40),
+    ("jobs.sweep_ms_1000", "down", 50.0),
     ("tracing.overhead_pct", "down", 4.0),
     ("logging.overhead_pct", "down", 4.0),
     ("profile.overhead_pct", "down", 4.0),

@@ -158,6 +158,21 @@ class ClientBackend:
     def remove_placement_group(self, pg_id: bytes) -> None:
         self._request({"type": "remove_pg", "pg_id": pg_id})
 
+    # -- job plane ------------------------------------------------------------
+    def set_quota(self, cpu_slots: int = 0, object_bytes: int = 0,
+                  device_bytes: int = 0, priority: int = 1) -> None:
+        """Install this connection's job quota (0 = unlimited). Byte
+        quotas reject over-limit puts/pins with QuotaExceededError;
+        cpu_slots backpressures task admission; priority weights the
+        router's fair share and gates leaf-lease preemption."""
+        self._request({"type": "set_quota", "quota": {
+            "cpu_slots": cpu_slots, "object_bytes": object_bytes,
+            "device_bytes": device_bytes, "priority": priority}})
+
+    def job_usage(self) -> dict:
+        """This connection's live quota usage (bytes, slots, counters)."""
+        return self._request({"type": "job_usage"})["usage"]
+
     def close(self) -> None:
         self._closed.set()
         try:

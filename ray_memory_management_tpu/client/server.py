@@ -30,10 +30,14 @@ class _JobState:
     """Per-connection resource ledger, reclaimed on disconnect."""
 
     __slots__ = ("job_id", "actors", "pgs", "puts", "refs", "mu", "closed",
-                 "proto_verified", "cpp_executors")
+                 "proto_verified", "cpp_executors", "conn_alive")
 
     def __init__(self, job_id: bytes):
         self.job_id = job_id
+        # flipped by _serve_conn's exit; a job with conn_alive False that
+        # was never reclaimed (dropped disconnect notification — the
+        # job.detach fault site) is an ORPHAN the watchdog sweeps
+        self.conn_alive = True
         # set by the first successful versioned ping; every other verb is
         # refused until then, so a frontend cannot skip the handshake and
         # speak unversioned (the node-registration and transfer planes
@@ -237,9 +241,19 @@ class ClusterServer:
         self._stop = threading.Event()
         self._conns_lock = threading.Lock()
         self._conns: set = set()
+        # live per-connection job states, keyed by job id: the watchdog
+        # scans these for orphans (conn gone, reclaim never ran)
+        self._job_states: Dict[bytes, _JobState] = {}  # guarded-by: _conns_lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rmt-client-accept")
         self._accept_thread.start()
+        self._watchdog_thread = None
+        interval = float(getattr(rt.config, "job_watchdog_interval_s", 0))
+        if interval > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, args=(interval,),
+                daemon=True, name="rmt-job-watchdog")
+            self._watchdog_thread.start()
 
     @property
     def port(self) -> int:
@@ -267,7 +281,9 @@ class ClusterServer:
     def _serve_conn(self, conn) -> None:
         send_lock = threading.Lock()
         job = _JobState(JobID.from_random().binary())
-        self._rt.gcs.register_job(job.job_id, {"type": "client"})
+        self._rt.register_client_job(job.job_id, {"type": "client"})
+        with self._conns_lock:
+            self._job_states[job.job_id] = job
         try:
             while not self._stop.is_set():
                 try:
@@ -284,13 +300,48 @@ class ClusterServer:
                 conn.close()
             except OSError:
                 pass
-            self._reclaim_job(job)
+            job.conn_alive = False
+            # job.detach fault site: the driver's disconnect notification
+            # can be lost (head-side thread dies before cleanup, network
+            # partition at exit). drop/error = the notification vanishes —
+            # reclaim is skipped HERE and the orphaned job must be found
+            # and swept by the watchdog instead.
+            from ..utils import faults
 
-    def _reclaim_job(self, job: _JobState) -> None:
+            act = faults.fire("job.detach")
+            if act is not None:
+                if act.mode == "stall":
+                    act.sleep()
+                elif act.mode in ("error", "drop"):
+                    return  # orphan: the watchdog sweeps it
+            with self._conns_lock:
+                self._job_states.pop(job.job_id, None)
+            self._reclaim_job(job, trigger="disconnect")
+
+    def _watchdog_loop(self, interval: float) -> None:
+        """Find jobs whose connection died but whose disconnect
+        notification was dropped (job.detach), and sweep them — driver
+        death must never leak a job, whatever happened to the notice."""
+        while not self._stop.wait(interval):
+            with self._conns_lock:
+                orphans = [j for j in self._job_states.values()
+                           if not j.conn_alive]
+                for j in orphans:
+                    self._job_states.pop(j.job_id, None)
+            for j in orphans:
+                try:
+                    self._reclaim_job(j, trigger="watchdog")
+                except Exception:  # noqa: BLE001 — the watchdog survives
+                    pass
+
+    def _reclaim_job(self, job: _JobState,
+                     trigger: str = "disconnect") -> None:
         """Disconnect cleanup: kill the job's non-detached actors, remove
-        its placement groups, free its put objects, finish its job row —
-        the reference kills a driver's leases and actors on driver death
-        the same way (gcs_job_manager.h:28 MarkJobFinished)."""
+        its placement groups, free its put objects, then run the
+        runtime's job-death sweep (ownership GC over everything the job
+        id tagged: directory rows, refcounts, device pins, quota ledger)
+        — the reference kills a driver's leases and actors on driver
+        death the same way (gcs_job_manager.h:28 MarkJobFinished)."""
         rt = self._rt
         with job.mu:
             job.closed = True
@@ -314,8 +365,8 @@ class ClusterServer:
         except Exception:  # noqa: BLE001
             pass
         try:
-            rt.gcs.set_job_state(job.job_id, "FINISHED")
-        except Exception:  # noqa: BLE001
+            rt.sweep_job(job.job_id, trigger=trigger)
+        except Exception:  # noqa: BLE001 — sweep retries ride heartbeats
             pass
 
     def _reclaim_one(self, kind: str, value) -> None:
@@ -356,24 +407,37 @@ class ClusterServer:
                     "handshake: clients must ping (with their proto "
                     "version) first")
             if mtype == "submit_task":
+                # the server stamps ownership — a client cannot submit
+                # under another job's id (quota/sweep isolation boundary)
+                msg["payload"]["job_id"] = job.job_id
                 reply["return_ids"] = rt.submit_task(
                     msg["payload"], adopt_returns=False)
             elif mtype == "submit_actor_task":
+                msg["payload"]["job_id"] = job.job_id
                 reply["return_ids"] = rt.submit_actor_task(
                     msg["payload"], adopt_returns=False)
             elif mtype == "create_actor":
+                msg["payload"]["job_id"] = job.job_id
                 reply["actor_id"] = rt.create_actor(msg["payload"])
                 track("actors", reply["actor_id"])
             elif mtype == "get_objects":
                 values = rt.get_objects(msg["oids"], msg.get("timeout"))
                 reply["values"] = [ser.dumps(v) for v in values]
             elif mtype == "put":
-                reply["object_id"] = rt.put_object(ser.loads(msg["data"]))
+                reply["object_id"] = rt.put_object(
+                    ser.loads(msg["data"]), job_id=job.job_id)
                 track("puts", reply["object_id"])
             elif mtype == "put_device":
                 reply["object_id"] = rt.put_device_object(
-                    ser.loads(msg["data"]))
+                    ser.loads(msg["data"]), job_id=job.job_id)
                 track("puts", reply["object_id"])
+            elif mtype == "set_quota":
+                # self-service quota (trusted clients); job_submission
+                # installs submit-time quotas through the same runtime call
+                rt.set_job_quota(job.job_id, msg.get("quota") or {})
+            elif mtype == "job_usage":
+                reply["usage"] = rt.job_usage(job.job_id)
+                reply["job_id"] = job.job_id
             elif mtype == "wait":
                 ready, not_ready = rt.wait(
                     msg["oids"], msg["num_returns"], msg["timeout"])
@@ -455,7 +519,7 @@ class ClusterServer:
             elif mtype == "put_bytes":
                 # raw-buffer puts for non-Python frontends: the value IS
                 # the bytes (no pickle envelope crosses the wire)
-                oid = rt.put_object(bytes(msg["data"]))
+                oid = rt.put_object(bytes(msg["data"]), job_id=job.job_id)
                 track("puts", oid)
                 reply["object_id"] = oid
             elif mtype == "get_bytes":

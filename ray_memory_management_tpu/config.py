@@ -189,6 +189,18 @@ _flag("leaf_lease_slots", int, 0,
       "spilling back to the head router only when saturated (the raylet "
       "two-level lease protocol, raylet_client.h:398). 0 = auto "
       "(2x the node's CPU count); negative disables leaf leasing.")
+# --- multi-tenant job plane --------------------------------------------------
+_flag("job_watchdog_interval_s", float, 0.5,
+      "Cadence of the cluster server's job watchdog: jobs whose client "
+      "connection closed but whose disconnect notification was dropped "
+      "(the job.detach fault site) are found and swept at this interval. "
+      "<=0 disables the watchdog (dropped detaches then leak until "
+      "shutdown — chaos-test territory only).")
+_flag("job_sweep_retry_s", float, 1.0,
+      "Delay before a job-death sweep that hit an error (the job.sweep "
+      "fault site, or a transient runtime error mid-step) is re-run by "
+      "the heartbeat loop. Sweeps are idempotent; retrying is always "
+      "safe.")
 _flag("reply_flush_window_s", float, 0.001,
       "Adaptive coalescing window for worker->head done replies: after "
       "the first queued reply the drain thread waits up to this long for "

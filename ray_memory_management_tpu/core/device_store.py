@@ -113,6 +113,7 @@ class DeviceObjectStore:
         self._bytes_avoided = 0  # guarded-by: _lock
         self.capacity_bytes = int(capacity_bytes)
         self._on_demote = on_demote
+        self._victim_rank: Optional[Callable[[bytes], int]] = None
 
     # -- configuration --------------------------------------------------------
     def set_demoter(self, on_demote: Callable[[bytes, Any], bool],
@@ -120,6 +121,15 @@ class DeviceObjectStore:
         self._on_demote = on_demote
         if capacity_bytes is not None:
             self.capacity_bytes = int(capacity_bytes)
+
+    def set_victim_rank(self,
+                        rank: Optional[Callable[[bytes], int]]) -> None:
+        """Optional job-aware demotion order: ``rank(oid)`` returns a
+        sort key and LOWER demotes first (the runtime passes the owning
+        job's priority, so a low-priority tenant's cold pins leave HBM
+        before a high-priority tenant's, with plain LRU breaking ties
+        within one rank). None restores pure LRU."""
+        self._victim_rank = rank
 
     # -- core tier operations -------------------------------------------------
     def put(self, object_id: bytes, array: Any) -> List[bytes]:
@@ -199,11 +209,31 @@ class DeviceObjectStore:
         (serialize + host-store write) runs outside it."""
         if self.capacity_bytes < 0 or self._on_demote is None:
             return []
+        rank = self._victim_rank
+        order: Optional[Dict[bytes, int]] = None
+        if rank is not None:
+            with self._lock:
+                cands = [oid for oid, e in self._objects.items()
+                         if e.pins == 0 and oid != keep]
+            # ranks resolve OUTSIDE the store lock: the callback reads
+            # runtime/GCS state, and nesting those locks under this one
+            # would invert the runtime -> store lock order
+            order = {}
+            for oid in cands:
+                try:
+                    order[oid] = rank(oid)
+                except Exception:  # noqa: BLE001 — rank is advisory
+                    order[oid] = 1 << 62
         victims: List[Tuple[bytes, _Entry]] = []
         with self._lock:
             if self._total <= self.capacity_bytes:
                 return []
-            for oid in list(self._objects):
+            walk = list(self._objects)
+            if order is not None:
+                # stable sort: LRU order survives within one rank tier;
+                # entries added since the snapshot demote last
+                walk.sort(key=lambda o: order.get(o, 1 << 62))
+            for oid in walk:
                 if self._total <= self.capacity_bytes:
                     break
                 entry = self._objects[oid]

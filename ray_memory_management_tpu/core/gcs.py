@@ -76,9 +76,9 @@ class _DirectoryShard:
     directory updates and free batches for different objects never
     contend on one lock. The three tables live and die together: a
     holder-set entry always has a tier entry, and both are dropped (with
-    the size) when the last holder leaves."""
+    the size and the job tag) when the last holder leaves."""
 
-    __slots__ = ("lock", "locations", "sizes", "tiers")
+    __slots__ = ("lock", "locations", "sizes", "tiers", "jobs")
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -92,6 +92,11 @@ class _DirectoryShard:
         # pinned by a process on that node — visible to locality scoring
         # but NOT host-readable; "shm" is the default host tier
         self.tiers: Dict[bytes, Dict[NodeID, str]] = {}  # guarded-by: lock
+        # owning job per object (16-byte job id). An EXPLICIT tag, not a
+        # task-id prefix match: a job-death sweep walks these rows and
+        # must never be able to touch another job's objects through a
+        # 4-byte prefix collision.
+        self.jobs: Dict[bytes, bytes] = {}  # guarded-by: lock
 
 
 class Pubsub:
@@ -296,7 +301,8 @@ class GCS:
     # and acquire each touched shard lock once.
     def add_object_location(self, oid: bytes, node_id: NodeID,
                             size: Optional[int] = None,
-                            tier: str = "shm") -> None:
+                            tier: str = "shm",
+                            job: Optional[bytes] = None) -> None:
         sh = self._shard(oid)
         with sh.lock:
             locs = sh.locations.get(oid)
@@ -307,6 +313,8 @@ class GCS:
             sh.tiers[oid][node_id] = tier
             if size is not None:
                 sh.sizes[oid] = size
+            if job is not None:
+                sh.jobs[oid] = job
 
     def remove_object_location(self, oid: bytes, node_id: NodeID) -> None:
         sh = self._shard(oid)
@@ -321,6 +329,7 @@ class GCS:
                     del sh.locations[oid]
                     sh.sizes.pop(oid, None)
                     sh.tiers.pop(oid, None)
+                    sh.jobs.pop(oid, None)
 
     def remove_device_location(self, oid: bytes, node_id: NodeID) -> None:
         """Drop a holder only while its copy is still device-tier: the
@@ -408,9 +417,35 @@ class GCS:
                     locs = sh.locations.pop(oid, None)
                     sh.sizes.pop(oid, None)
                     sh.tiers.pop(oid, None)
+                    sh.jobs.pop(oid, None)
                     if locs:
                         out[oid] = locs
         return out
+
+    def job_object_keys(self, job_id: bytes) -> List[bytes]:
+        """Every directory oid explicitly tagged as owned by ``job_id``
+        — the walk a job-death sweep starts from. Only tagged rows are
+        returned: an untagged row belongs to the in-process driver and
+        is never a sweep candidate."""
+        out: List[bytes] = []
+        for sh in self._shards:
+            with sh.lock:
+                out.extend(oid for oid, j in sh.jobs.items() if j == job_id)
+        return out
+
+    def count_job_rows(self, job_id: bytes) -> int:
+        """Live directory rows still tagged to ``job_id`` (leak probe:
+        must be zero after the job's sweep completes)."""
+        n = 0
+        for sh in self._shards:
+            with sh.lock:
+                n += sum(1 for j in sh.jobs.values() if j == job_id)
+        return n
+
+    def object_job(self, oid: bytes) -> Optional[bytes]:
+        sh = self._shard(oid)
+        with sh.lock:
+            return sh.jobs.get(oid)
 
     def drop_node_objects(self, node_id: NodeID) -> List[bytes]:
         """Remove a dead node from the directory; returns objects that now
@@ -427,6 +462,7 @@ class GCS:
                         del sh.locations[oid]
                         sh.sizes.pop(oid, None)
                         sh.tiers.pop(oid, None)
+                        sh.jobs.pop(oid, None)
                         orphaned.append(oid)
         return orphaned
 

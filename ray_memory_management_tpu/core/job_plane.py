@@ -1,0 +1,308 @@
+"""Per-job tenancy substrate: quotas, usage ledgers, fair-share state.
+
+The runtime multiplexes many drivers (the in-process driver plus every
+thin client and every ``job_submission`` subprocess); this module holds
+the per-job half of that multiplexing:
+
+  - ``JobQuota`` — admission limits enforced at the submit / put /
+    device-pin edges (the analog of the reference's per-job resource
+    isolation, which Ray itself never shipped beyond placement groups);
+  - ``JobLedger`` — one per live job: usage counters, the owned-object
+    tables a job-death sweep walks, the cpu-slot throttle queue, and the
+    stride-scheduling virtual time the router's fair-share pass keys on.
+
+Quota semantics (documented in README "Multi-tenant job plane"):
+
+  - ``object_bytes`` / ``device_bytes`` are HARD admission limits — an
+    over-quota put or device-pin raises ``QuotaExceededError`` at the
+    call site and touches nothing. They never trigger eviction of
+    another job's state: quota rejection is strictly local to the
+    requesting job.
+  - ``cpu_slots`` is BACKPRESSURE, not rejection: at most ``cpu_slots``
+    of the job's tasks are in flight (scheduled-to-finished); excess
+    submissions queue in the ledger and release as tasks finish. A task
+    submitted at exactly the quota boundary runs; the one after waits.
+  - ``priority`` orders jobs for the router's weighted-fair drain
+    (stride scheduling: a job advances its virtual time by
+    ``1/priority`` per dispatched task, lowest time goes first) and
+    gates leaf-lease preemption: a strictly-higher-priority job may
+    evict a lower-priority job's leaf tasks when the credit pool is dry.
+  - Demotion interplay: when the device tier demotes an HBM object to
+    host shm, its bytes MOVE from ``device_bytes`` to ``object_bytes``
+    accounting — demoted bytes stop counting against the device quota.
+
+A quota field of 0 means unlimited (the default job runs unconstrained,
+exactly as the single-tenant runtime did).
+
+Ledger locks are LEAF locks: no ledger method calls back into the
+runtime or takes any other lock, so callers may hold the runtime lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Set
+
+from ..exceptions import QuotaExceededError
+
+
+class JobQuota:
+    """Admission limits for one job. 0 = unlimited."""
+
+    __slots__ = ("cpu_slots", "object_bytes", "device_bytes", "priority")
+
+    def __init__(self, cpu_slots: int = 0, object_bytes: int = 0,
+                 device_bytes: int = 0, priority: int = 1):
+        self.cpu_slots = max(0, int(cpu_slots))
+        self.object_bytes = max(0, int(object_bytes))
+        self.device_bytes = max(0, int(device_bytes))
+        self.priority = max(1, int(priority))
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "JobQuota":
+        d = d or {}
+        return cls(
+            cpu_slots=d.get("cpu_slots", 0),
+            object_bytes=d.get("object_bytes", 0),
+            device_bytes=d.get("device_bytes", 0),
+            priority=d.get("priority", 1),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "cpu_slots": self.cpu_slots,
+            "object_bytes": self.object_bytes,
+            "device_bytes": self.device_bytes,
+            "priority": self.priority,
+        }
+
+    def __repr__(self):
+        return (f"JobQuota(cpu_slots={self.cpu_slots}, "
+                f"object_bytes={self.object_bytes}, "
+                f"device_bytes={self.device_bytes}, "
+                f"priority={self.priority})")
+
+
+class JobLedger:
+    """Usage accounting + fair-share state for one live job.
+
+    The ledger is the sweep's manifest: ``owned_object_ids()`` is every
+    object the job created by put (host or device) that the runtime must
+    release when the job dies, and ``actors`` is every actor it created.
+    Task-created state (return objects, refcounts) is found through the
+    task table instead — task ids carry the job id on their spec.
+    """
+
+    __slots__ = (
+        "job_id", "quota", "lock",
+        "object_sizes", "object_bytes",
+        "device_sizes", "device_bytes",
+        "actors", "slots", "throttled",
+        "stride_pass", "tasks_submitted", "tasks_finished",
+        "preempted_total", "rejections_total", "swept",
+    )
+
+    def __init__(self, job_id: bytes, quota: Optional[JobQuota] = None):
+        self.job_id = job_id
+        self.quota = quota or JobQuota()
+        self.lock = threading.Lock()
+        # host-tier objects this job created by put: oid -> bytes.
+        # Demoted device objects migrate here (see note_demoted).
+        self.object_sizes: Dict[bytes, int] = {}  # guarded-by: lock
+        self.object_bytes = 0  # guarded-by: lock
+        # device-tier (HBM) objects this job pinned: oid -> bytes
+        self.device_sizes: Dict[bytes, int] = {}  # guarded-by: lock
+        self.device_bytes = 0  # guarded-by: lock
+        self.actors: Set[bytes] = set()  # guarded-by: lock
+        # cpu_slots throttle: task ids currently holding a slot, plus the
+        # specs waiting for one (drained FIFO as slots free)
+        self.slots: Set[bytes] = set()  # guarded-by: lock
+        self.throttled: Deque = deque()  # guarded-by: lock
+        # stride-scheduling virtual time: advanced 1/priority per
+        # dispatched task; the router drains the lowest-pass job first
+        self.stride_pass = 0.0  # guarded-by: lock
+        self.tasks_submitted = 0
+        self.tasks_finished = 0
+        self.preempted_total = 0
+        self.rejections_total = 0
+        self.swept = False
+
+    # -- byte quotas (hard admission) ------------------------------------
+    def admit_object(self, oid: bytes, nbytes: int) -> None:
+        """Charge a host-tier put against object_bytes or raise."""
+        with self.lock:
+            limit = self.quota.object_bytes
+            if limit and self.object_bytes + nbytes > limit \
+                    and oid not in self.object_sizes:
+                self.rejections_total += 1
+                raise QuotaExceededError(
+                    self.job_id.hex(), "object_bytes",
+                    nbytes, limit, self.object_bytes)
+            prev = self.object_sizes.get(oid)
+            self.object_sizes[oid] = nbytes
+            self.object_bytes += nbytes - (prev or 0)
+
+    def admit_device(self, oid: bytes, nbytes: int) -> None:
+        """Charge a device pin against device_bytes or raise."""
+        with self.lock:
+            limit = self.quota.device_bytes
+            if limit and self.device_bytes + nbytes > limit \
+                    and oid not in self.device_sizes:
+                self.rejections_total += 1
+                raise QuotaExceededError(
+                    self.job_id.hex(), "device_bytes",
+                    nbytes, limit, self.device_bytes)
+            prev = self.device_sizes.get(oid)
+            self.device_sizes[oid] = nbytes
+            self.device_bytes += nbytes - (prev or 0)
+
+    def release_object(self, oid: bytes) -> int:
+        with self.lock:
+            n = self.object_sizes.pop(oid, 0)
+            self.object_bytes -= n
+            return n
+
+    def release_device(self, oid: bytes) -> int:
+        with self.lock:
+            n = self.device_sizes.pop(oid, 0)
+            self.device_bytes -= n
+            return n
+
+    def release_many(self, oids) -> None:
+        """Batch uncharge (free_objects path): cheap no-op for oids this
+        job never charged."""
+        with self.lock:
+            for oid in oids:
+                n = self.object_sizes.pop(oid, 0)
+                if n:
+                    self.object_bytes -= n
+                n = self.device_sizes.pop(oid, 0)
+                if n:
+                    self.device_bytes -= n
+
+    def note_demoted(self, oid: bytes) -> None:
+        """HBM -> host demotion: the bytes stop counting against
+        ``device_bytes`` and start counting against ``object_bytes``
+        (never rejected — demotion is a system action, not a request)."""
+        with self.lock:
+            n = self.device_sizes.pop(oid, 0)
+            if n:
+                self.device_bytes -= n
+                prev = self.object_sizes.get(oid, 0)
+                self.object_sizes[oid] = n
+                self.object_bytes += n - prev
+
+    def owned_object_ids(self) -> List[bytes]:
+        with self.lock:
+            return list(self.object_sizes.keys()) \
+                + list(self.device_sizes.keys())
+
+    # -- cpu_slots throttle (backpressure) -------------------------------
+    def try_take_slot(self, task_id: bytes) -> bool:
+        """Claim an in-flight slot; False means the caller must park the
+        spec via park(). Unlimited quota always succeeds. Idempotent per
+        task id (a retry re-enters scheduling with its slot held)."""
+        with self.lock:
+            limit = self.quota.cpu_slots
+            if task_id in self.slots:
+                return True
+            if limit and len(self.slots) >= limit:
+                return False
+            self.slots.add(task_id)
+            return True
+
+    def park(self, spec) -> None:
+        with self.lock:
+            self.throttled.append(spec)
+
+    def release_slot(self, task_id: bytes):
+        """Return a finished/failed task's slot; hands back the next
+        parked spec (if any) for the caller to re-enter scheduling.
+        Idempotent: releasing an unheld slot unparks nothing."""
+        with self.lock:
+            if task_id not in self.slots:
+                return None
+            self.slots.discard(task_id)
+            if self.throttled:
+                spec = self.throttled.popleft()
+                self.slots.add(spec.task_id)
+                return spec
+            return None
+
+    def drain_parked(self) -> list:
+        """Sweep path: every spec still waiting for a slot."""
+        with self.lock:
+            out = list(self.throttled)
+            self.throttled.clear()
+            self.slots.clear()
+            return out
+
+    # -- fair share ------------------------------------------------------
+    def peek_pass(self) -> float:
+        with self.lock:
+            return self.stride_pass
+
+    def advance_pass(self) -> float:
+        """One dispatch charged against this job's virtual time; higher
+        priority advances slower and therefore drains more often."""
+        with self.lock:
+            self.stride_pass += 1.0 / self.quota.priority
+            return self.stride_pass
+
+    def usage(self) -> dict:
+        with self.lock:
+            return {
+                "object_bytes": self.object_bytes,
+                "object_count": len(self.object_sizes),
+                "device_bytes": self.device_bytes,
+                "device_count": len(self.device_sizes),
+                "tasks_inflight": len(self.slots),
+                "tasks_parked": len(self.throttled),
+                "tasks_submitted": self.tasks_submitted,
+                "tasks_finished": self.tasks_finished,
+                "actors": len(self.actors),
+                "preempted": self.preempted_total,
+                "rejections": self.rejections_total,
+                "priority": self.quota.priority,
+                "quota": self.quota.to_dict(),
+            }
+
+
+def fair_order(specs, ledger_of) -> list:
+    """Stride-scheduling interleave of one drained submit batch.
+
+    ``ledger_of(spec)`` maps a spec to its job's ledger. Within a job,
+    FIFO order is preserved; across jobs, the next spec always comes
+    from the job with the lowest virtual time, which converges to
+    priority-weighted shares. Single-job batches return unchanged (the
+    common case pays one dict insert, no sort).
+    """
+    import heapq
+
+    by_job: Dict[bytes, Deque] = {}
+    order: List[bytes] = []
+    for spec in specs:
+        led = ledger_of(spec)
+        key = led.job_id
+        q = by_job.get(key)
+        if q is None:
+            q = by_job[key] = deque()
+            order.append(key)
+        q.append((spec, led))
+    if len(by_job) <= 1:
+        return list(specs)
+    heap = []
+    for i, key in enumerate(order):
+        _, led = by_job[key][0]
+        heapq.heappush(heap, (led.peek_pass(), i, key))
+    out: List = []
+    while heap:
+        _, i, key = heapq.heappop(heap)
+        q = by_job[key]
+        spec, led = q.popleft()
+        out.append(spec)
+        new_pass = led.advance_pass()
+        if q:
+            heapq.heappush(heap, (new_pass, i, key))
+    return out

@@ -306,6 +306,31 @@ DEFS: Dict[str, tuple] = {
                     "deployment's weights object from the tier-tagged "
                     "locality directory, default = no hint).",
         tag_keys=("mode",))),
+    # multi-tenant job plane (core/job_plane.py: quotas, sweeps,
+    # preemption — the tenancy instrument set: a leaked job shows up as
+    # a non-zero post-sweep gauge, not just missing HBM bytes)
+    "rmt_jobs_active": (Gauge, dict(
+        description="Jobs with a live ledger (driver + connected "
+                    "clients + job_submission drivers).")),
+    "rmt_job_sweeps_total": (Counter, dict(
+        description="Job-death sweeps completed, by trigger "
+                    "(disconnect = client conn closed, watchdog = "
+                    "dropped-detach recovery, stop = explicit job stop, "
+                    "retry = re-run after an injected sweep error).",
+        tag_keys=("trigger",))),
+    "rmt_job_preemptions_total": (Counter, dict(
+        description="Leaf-lease preemptions: a higher-priority job "
+                    "evicted a lower-priority job's leaf task (the "
+                    "victim re-queues on a free retry).")),
+    "rmt_job_quota_rejections_total": (Counter, dict(
+        description="Admissions rejected by a job quota, by resource "
+                    "(object_bytes | device_bytes).",
+        tag_keys=("resource",))),
+    "rmt_job_sweep_seconds": (Histogram, dict(
+        description="Wall time per job-death sweep (walk the job's "
+                    "directory/refcount rows, free objects, kill "
+                    "actors, cancel leases).",
+        boundaries=LATENCY_BOUNDARIES)),
     # profiling plane (utils/profiler.py)
     "rmt_proc_cpu_seconds_total": (Counter, dict(
         description="Process CPU seconds (user+system) accumulated, by "
@@ -628,6 +653,26 @@ def serve_cold_start_seconds() -> Histogram:
 
 def serve_replica_placements() -> Counter:
     return get("rmt_serve_replica_placements_total")
+
+
+def jobs_active() -> Gauge:
+    return get("rmt_jobs_active")
+
+
+def job_sweeps() -> Counter:
+    return get("rmt_job_sweeps_total")
+
+
+def job_preemptions() -> Counter:
+    return get("rmt_job_preemptions_total")
+
+
+def job_quota_rejections() -> Counter:
+    return get("rmt_job_quota_rejections_total")
+
+
+def job_sweep_seconds() -> Histogram:
+    return get("rmt_job_sweep_seconds")
 
 
 def profile_samples() -> Counter:

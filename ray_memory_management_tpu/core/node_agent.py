@@ -36,6 +36,8 @@ agent -> head:
     wdeath      {wid}                      worker pipe EOF
     lease_spill {task_id}                  leaf pool saturated: head reroutes
     lease_dead  {task_id}                  leased task's worker died
+    lease_cancel {task_id}                 job sweep: kill the pool worker
+                                           running a dead job's leased task
     push_ack    {req, error}               object landed (or failed)
     pull_data   {req, off, data, eof, error}
     pong
@@ -807,6 +809,20 @@ class NodeAgent:
                 self._start_worker(msg)
             elif t == "kill_worker":
                 proc = self._worker_procs.get(msg["wid"])
+                if proc is not None:
+                    try:
+                        proc.terminate()
+                    except Exception:
+                        pass
+            elif t == "lease_cancel":
+                # job sweep: a leased task of a dead job may be RUNNING
+                # on a pool worker only this agent can name — kill that
+                # worker; wdeath/lease_dead settle the accounting and
+                # the head fails the (cancelled) retry
+                with self._lock:
+                    wid = self._lease_task_wid.get(msg["task_id"])
+                    proc = (self._worker_procs.get(wid)
+                            if wid is not None else None)
                 if proc is not None:
                     try:
                         proc.terminate()

@@ -507,6 +507,13 @@ class NodeManager:
                 self.leaf_credits += 1
             return spec
 
+    def cancel_leaf(self, task_id: bytes) -> None:
+        """Job sweep: nothing to do locally — a local leaf task is
+        either in the dispatch queue (the sweep drops it there) or in a
+        worker handle's inflight map (the sweep's victim scan terminates
+        that worker). The remote override asks the agent to kill the
+        pool worker only IT can name."""
+
     def release_leaf(self, task_id: bytes) -> None:
         """Return the credit of a LOCAL leaf task whose worker died
         before finish_task could run (the death handler cleared the
@@ -526,6 +533,36 @@ class NodeManager:
             self.leaf_inflight.clear()
             self.leaf_credits += len(out)
             return out
+
+    def preempt_leaf(self, victim_ok):
+        """Priority preemption over this node's LOCAL leaf pool: evict
+        one leaf task for which ``victim_ok(task_id)`` is True (the
+        runtime passes a lower-priority-job predicate; it must not block
+        — it runs under the node lock).
+
+        Prefers a QUEUED victim — removed from the dispatch queue with
+        its credit returned synchronously, zero wasted work; falls back
+        to a RUNNING victim whose worker holds nothing else (the caller
+        terminates the worker and the ordinary death path returns the
+        credit and re-queues the task). Returns ``("queued", spec)``,
+        ``("running", (task_id, handle))``, or None."""
+        with self._lock:
+            if not self.alive:
+                return None
+            for i, spec in enumerate(self.queue):
+                if spec.task_id in self.leaf_local \
+                        and victim_ok(spec.task_id):
+                    del self.queue[i]
+                    self.leaf_local.discard(spec.task_id)
+                    self.leaf_credits += 1
+                    return ("queued", spec)
+            for h in self.workers.values():
+                if h.actor_id is not None or len(h.inflight) != 1:
+                    continue
+                tid = next(iter(h.inflight))
+                if tid in self.leaf_local and victim_ok(tid):
+                    return ("running", (tid, h))
+            return None
 
     def try_dispatch(
         self, send: Callable[[WorkerHandle, TaskSpec], None]

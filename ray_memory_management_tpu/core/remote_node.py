@@ -411,6 +411,16 @@ class RemoteNodeManager(NodeManager):
             return False
         return True
 
+    def cancel_leaf(self, task_id: bytes) -> None:
+        """Job sweep: a leased task of a dead job may be RUNNING on a
+        pool worker only the AGENT can name (the head never learned the
+        placement — that was the point of the lease). Ask the agent to
+        kill that worker; the resulting wdeath/lease_dead frames settle
+        accounting through the normal death path, and the retry lands in
+        _cancelled and fails. Best-effort: a dead channel means the node
+        sweep already reclaimed everything."""
+        self.channel_send({"type": "lease_cancel", "task_id": task_id})
+
     # ------------------------------------------------------------ worker pool
     def start_conda_worker(self, conda_spec, conda_key: str) -> None:
         """Remote flavor of the dedicated conda-env worker: the env is

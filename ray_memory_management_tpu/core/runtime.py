@@ -38,6 +38,8 @@ from ..exceptions import (
     NodeDeadError,
     ObjectLostError,
     ObjectStoreFullError,
+    QuotaExceededError,
+    RmtError,
     TaskError,
     WorkerCrashedError,
 )
@@ -410,6 +412,10 @@ class Runtime:
         self.device_store = DeviceObjectStore(
             capacity_bytes=resolve_capacity(config),
             on_demote=self._demote_device_object)
+        # job-aware demotion order: under HBM pressure a low-priority
+        # tenant's cold pins demote before a high-priority tenant's
+        # (LRU within one priority); driver-owned pins demote last
+        self.device_store.set_victim_rank(self._device_victim_rank)
         # device-object ownership: oid -> "driver" | WorkerHandle
         self._device_locations: Dict[bytes, Any] = {}
         # driver device objects demoted to host, eligible for
@@ -532,6 +538,23 @@ class Runtime:
         self._submit_q: deque = deque()
         self._submit_nudged = False
         self._cancelled: Set[bytes] = set()
+        # multi-tenant job plane (job_plane.py): one ledger per live job
+        # holding quota state, usage accounting, the cpu-slot throttle and
+        # stride-scheduling virtual time. The in-process driver's own job
+        # gets an unlimited ledger so the single-tenant path is unchanged.
+        from .job_plane import JobLedger
+
+        self._job_ledgers: Dict[bytes, JobLedger] = {
+            self.job_id.binary(): JobLedger(self.job_id.binary())
+        }  # guarded-by: _lock (ledger internals self-locked, leaf locks)
+        self._swept_jobs: Set[bytes] = set()  # guarded-by: _lock
+        # job -> (monotonic deadline, trigger) for re-running a sweep that
+        # hit an error (job.sweep fault site); drained by the heartbeat loop
+        self._sweep_retry: Dict[bytes, tuple] = {}  # guarded-by: _lock
+        self._m_job_sweeps = mdefs.job_sweeps()
+        self._m_job_preempted = mdefs.job_preemptions()
+        self._m_quota_rej = mdefs.job_quota_rejections()
+        mdefs.jobs_active().set(float(len(self._job_ledgers)))
 
         self._lock = threading.RLock()
         self._conn_handles: Dict[Any, WorkerHandle] = {}
@@ -1339,7 +1362,14 @@ class Runtime:
 
     def submit_task(self, payload: dict,
                     adopt_returns: bool = True) -> List[bytes]:
-        task_id = TaskID.for_task(self.job_id)
+        # owning job: thin clients / job_submission drivers tag their
+        # payloads; untagged submits (the in-process driver, worker-side
+        # nested submits) belong to the root job. The task id inherits
+        # the job's 4-byte prefix so returns are attributable by eye.
+        job = payload.get("job_id") or self.job_id.binary()
+        led = self.ledger_for(job)
+        task_id = TaskID.for_task(
+            self.job_id if job == self.job_id.binary() else JobID(job))
         num_returns = payload.get("num_returns", 1)
         return_ids = [
             ObjectID.for_return(task_id, i).binary() for i in range(num_returns)
@@ -1369,10 +1399,13 @@ class Runtime:
             retry_exceptions=payload.get("retry_exceptions", False),
             runtime_env=payload.get("runtime_env"),
             trace_ctx=trace_ctx,
+            job_id=job,
         )
         rec = _TaskRecord(spec, payload, spec.max_retries,
                           gc_returns=adopt_returns)
         self._m_submitted.inc()
+        with led.lock:
+            led.tasks_submitted += 1
         with self._lock:
             self.tasks[spec.task_id] = rec
             self._index_trace_locked(trace_ctx, spec.task_id)
@@ -1487,6 +1520,7 @@ class Runtime:
         if rec:
             self._m_failed.inc()
         self._release_task_args(spec)
+        self._release_job_slot(spec)
 
     # --------------------------------------------- agent-local leaf scheduling
     def _leaf_eligible(self, spec: TaskSpec) -> bool:
@@ -2145,10 +2179,19 @@ class Runtime:
         # Locality is computed for the WHOLE batch up front — one GCS
         # directory lookup over the union of every task's ref args, not
         # one per task per candidate node
-        for batch in (submits, pending):
+        multi_job = len(self._job_ledgers) > 1
+        for batch, fresh in ((submits, True), (pending, False)):
             if not batch:
                 continue
-            if batch is submits and self._leaf_enabled:
+            if multi_job:
+                # job plane: park specs whose job is at its cpu_slots cap
+                # (they re-enter as slots free), then interleave the rest
+                # by stride-scheduled virtual time so concurrent jobs get
+                # priority-weighted fair shares of this drain
+                batch = self._admit_batch(batch)
+                if not batch:
+                    continue
+            if fresh and self._leaf_enabled:
                 # leaf fast path: fresh submits only — spillbacks and
                 # retries arrive via _pending_schedule and always take
                 # the full pass (no leaf ping-pong)
@@ -2156,7 +2199,7 @@ class Runtime:
                 for spec in batch:
                     if (spec.task_id in self._cancelled
                             or not self._leaf_eligible(spec)
-                            or not self._try_leaf_place(spec)):
+                            or not self._try_leaf_place_or_preempt(spec)):
                         rest.append(spec)
                 batch = rest
                 if not batch:
@@ -2403,6 +2446,12 @@ class Runtime:
         if rusage_list:
             self._record_task_resources(rusage_list)
         self.free_objects(to_free)
+        if len(self._job_ledgers) > 1:
+            # cpu_slots throttle: finished tasks return their slots and
+            # pull the next parked spec of their job into the submit queue
+            for m, spec in simple:
+                if spec is not None:
+                    self._release_job_slot(spec, finished=True)
         if nudge:
             self._wakeup()
 
@@ -2440,6 +2489,13 @@ class Runtime:
     # --------------------------------------------------------------- actors
     def create_actor(self, payload: dict) -> bytes:
         actor_id = ActorID.from_random()
+        # owning job: the job-death sweep kills the job's actors through
+        # its ledger (detached actors included — detachment outlives the
+        # DRIVER CONNECTION, not the job itself)
+        job = payload.get("job_id") or self.job_id.binary()
+        led = self.ledger_for(job)
+        with led.lock:
+            led.actors.add(actor_id.binary())
         if payload.get("cls_blob") is not None:
             self.cls_blobs.setdefault(payload["cls_id"], payload["cls_blob"])
         spec = ActorCreationSpec(
@@ -2600,7 +2656,10 @@ class Runtime:
             info = self.actors.get(actor_id)
         if info is None:
             raise ActorDiedError("unknown actor")
-        task_id = TaskID.for_task(self.job_id)
+        job = payload.get("job_id") or self.job_id.binary()
+        led = self.ledger_for(job)
+        task_id = TaskID.for_task(
+            self.job_id if job == self.job_id.binary() else JobID(job))
         num_returns = payload.get("num_returns", 1)
         return_ids = [
             ObjectID.for_return(task_id, i).binary() for i in range(num_returns)
@@ -2622,10 +2681,13 @@ class Runtime:
             seq=next(info.seq),
             max_retries=info.spec.max_task_retries,
             trace_ctx=trace_ctx,
+            job_id=job,
         )
         rec = _TaskRecord(spec, payload, info.spec.max_task_retries,
                           gc_returns=adopt_returns)
         self._m_submitted.inc()
+        with led.lock:
+            led.tasks_submitted += 1
         with self._lock:
             self.tasks[spec.task_id] = rec
             self._index_trace_locked(trace_ctx, spec.task_id)
@@ -2913,6 +2975,391 @@ class Runtime:
         if info.spec.placement is not None and self.pg_manager is not None:
             self.pg_manager.release_key(info.spec.actor_id)
 
+    # ------------------------------------------------------------- job plane
+    def ledger_for(self, job_id: Optional[bytes]):
+        """Get-or-create the ledger for ``job_id`` (None = the root job).
+        A swept (dead) job raises: no new work may charge against it."""
+        from .job_plane import JobLedger
+
+        jid = job_id or self.job_id.binary()
+        with self._lock:
+            if jid in self._swept_jobs:
+                raise RmtError(f"job {jid.hex()[:8]} is dead (swept)")
+            led = self._job_ledgers.get(jid)
+            if led is None:
+                led = self._job_ledgers[jid] = JobLedger(jid)
+                mdefs.jobs_active().set(float(len(self._job_ledgers)))
+            return led
+
+    def set_job_quota(self, job_id: bytes, quota: Optional[dict]) -> None:
+        """Install (or replace) a job's admission quota. Applies to new
+        admissions only — already-held bytes/slots are never clawed back."""
+        from .job_plane import JobQuota
+
+        self.ledger_for(job_id).quota = JobQuota.from_dict(quota)
+
+    def register_client_job(self, job_id: bytes, info: Optional[dict] = None,
+                            quota: Optional[dict] = None) -> None:
+        """A driver (thin client / job_submission subprocess) joined:
+        GCS job row + fresh ledger. Re-registering a swept job id fails."""
+        self.gcs.register_job(job_id, info or {})
+        led = self.ledger_for(job_id)
+        if quota:
+            from .job_plane import JobQuota
+
+            led.quota = JobQuota.from_dict(quota)
+
+    def job_usage(self, job_id: Optional[bytes] = None) -> dict:
+        """Per-job (or all-jobs) usage snapshot for state/CLI surfaces."""
+        with self._lock:
+            ledgers = ({job_id: self._job_ledgers[job_id]}
+                       if job_id is not None
+                       and job_id in self._job_ledgers
+                       else dict(self._job_ledgers))
+        out = {}
+        for jid, led in ledgers.items():
+            u = led.usage()
+            u["directory_rows"] = self.gcs.count_job_rows(jid)
+            out[jid.hex()] = u
+        return out
+
+    def _admit_job_bytes(self, job_id: Optional[bytes], oid: bytes,
+                         nbytes: int, device: bool = False) -> None:
+        """Hard byte-quota admission for a put / device pin. Raises
+        QuotaExceededError at the call edge; charges the job's ledger on
+        success (released again by free_objects)."""
+        if job_id is None:
+            return  # untagged put: the root job, unlimited
+        led = self.ledger_for(job_id)
+        try:
+            if device:
+                led.admit_device(oid, nbytes)
+            else:
+                led.admit_object(oid, nbytes)
+        except QuotaExceededError:
+            self._m_quota_rej.inc(tags={
+                "resource": "device_bytes" if device else "object_bytes"})
+            raise
+
+    def _note_job_demotion(self, oid: bytes) -> None:
+        """Device→host demotion: migrate the bytes from the owning job's
+        device_bytes to its object_bytes accounting."""
+        jid = self.gcs.object_job(oid)
+        if jid is None:
+            return
+        led = self._job_ledgers.get(jid)  # lock-free dict read
+        if led is not None:
+            led.note_demoted(oid)
+
+    def _device_victim_rank(self, oid: bytes) -> int:
+        """Demotion sort key for the device tier (lower demotes first):
+        a client job's pins rank at its quota priority, driver-owned
+        pins rank last. Called by the store OUTSIDE its lock."""
+        jid = self.gcs.object_job(oid)
+        if jid is None or jid == self.job_id.binary():
+            return 1 << 30
+        led = self._job_ledgers.get(jid)  # lock-free dict read
+        return led.quota.priority if led is not None else 1
+
+    def _release_job_bytes(self, oids) -> None:
+        """free_objects hook: uncharge freed oids from every ledger."""
+        with self._lock:
+            ledgers = list(self._job_ledgers.values())
+        if len(ledgers) <= 1:
+            return  # root job only: unlimited, nothing charged
+        for led in ledgers:
+            led.release_many(oids)
+
+    def _admit_batch(self, specs: List[TaskSpec]) -> List[TaskSpec]:
+        """Router-only: cpu_slots throttle + stride-fair interleave over
+        one drained submit batch (see job_plane.fair_order)."""
+        from .job_plane import fair_order
+
+        ledgers: Dict[bytes, Any] = {}
+
+        def led_of(spec):
+            jid = spec.job_id or self.job_id.binary()
+            led = ledgers.get(jid)
+            if led is None:
+                with self._lock:
+                    led = self._job_ledgers.get(jid)
+                if led is None:
+                    # swept mid-flight: let _schedule fail the task via
+                    # the root ledger (unlimited, never parks)
+                    led = self._job_ledgers[self.job_id.binary()]
+                ledgers[jid] = led
+            return led
+
+        admitted = []
+        for spec in specs:
+            led = led_of(spec)
+            if spec.task_id in self._cancelled \
+                    or led.try_take_slot(spec.task_id):
+                admitted.append(spec)
+            else:
+                led.park(spec)
+        return fair_order(admitted, led_of)
+
+    def _release_job_slot(self, spec: TaskSpec,
+                          finished: bool = False) -> None:
+        """Terminal-path hook for the cpu_slots throttle: return the
+        task's slot and queue its job's next parked spec (if any)."""
+        jid = spec.job_id
+        if jid is None:
+            return
+        led = self._job_ledgers.get(jid)  # lock-free dict read
+        if led is None:
+            return
+        if finished:
+            with led.lock:
+                led.tasks_finished += 1
+        nxt = led.release_slot(spec.task_id)
+        if nxt is not None:
+            with self._lock:
+                self._submit_q.append(nxt)
+                nudge = not self._submit_nudged
+                self._submit_nudged = True
+            if nudge:
+                self._wakeup()
+
+    def _try_leaf_place_or_preempt(self, spec: TaskSpec) -> bool:
+        """Leaf placement with priority preemption: when every lease pool
+        is dry and the submitting job outranks a job holding leaf work,
+        evict one victim and retry. A queued victim frees its credit
+        synchronously; a running victim frees it via worker death, so the
+        spec falls back to the shared scheduler this round."""
+        if self._try_leaf_place(spec):
+            return True
+        if len(self._job_ledgers) > 1 and self._preempt_leaf_for(spec):
+            return self._try_leaf_place(spec)
+        return False
+
+    def _preempt_leaf_for(self, spec: TaskSpec) -> bool:
+        """Evict one lower-priority leaf task to make room for ``spec``.
+        Returns True when a victim was preempted (its credit freed now or
+        freeing via worker death). Preemption rides the existing retry
+        machinery: the victim's retry budget is refunded, so preemption
+        never consumes a retry the application paid for."""
+        my_jid = spec.job_id or self.job_id.binary()
+        led = self._job_ledgers.get(my_jid)
+        my_pri = led.quota.priority if led is not None else 1
+        if my_pri <= 1:
+            return False  # baseline priority never preempts
+        # snapshot victim priorities OUTSIDE the node locks (victim_ok
+        # runs under nm._lock, which must never wait on runtime state)
+        prio: Dict[bytes, int] = {}
+        with self._lock:
+            for tid, rec in self.tasks.items():
+                jid = rec.spec.job_id or self.job_id.binary()
+                if jid == my_jid:
+                    continue
+                vled = self._job_ledgers.get(jid)
+                prio[tid] = vled.quota.priority if vled is not None else 1
+
+        def victim_ok(tid: bytes) -> bool:
+            return prio.get(tid, my_pri) < my_pri
+
+        for nm in list(self.nodes.values()):
+            res = nm.preempt_leaf(victim_ok)
+            if res is None:
+                continue
+            kind, payload = res
+            self._m_job_preempted.inc()
+            if kind == "queued":
+                # victim never started: free re-queue through the full
+                # scheduling pass (credit already returned by the node)
+                vspec = payload
+                vled = self._job_ledgers.get(vspec.job_id or b"")
+                if vled is not None:
+                    with vled.lock:
+                        vled.preempted_total += 1
+                with self._lock:
+                    self._pending_schedule.append(vspec)
+                return True
+            # running victim: refund the retry this eviction will consume,
+            # then kill the worker — _on_worker_death releases the leaf
+            # credit and _maybe_retry re-queues the task
+            tid, handle = payload
+            with self._lock:
+                rec = self.tasks.get(tid)
+                if rec is not None:
+                    rec.retries_left += 1
+                    vjid = rec.spec.job_id or self.job_id.binary()
+                    vled = self._job_ledgers.get(vjid)
+                    if vled is not None:
+                        with vled.lock:
+                            vled.preempted_total += 1
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+            return True
+        return False
+
+    def sweep_job(self, job_id: bytes, trigger: str = "disconnect") -> bool:
+        """Job-death sweep: release EVERYTHING the dead job owns — cancel
+        its queued/parked/running tasks, kill its actors, drop its
+        refcount rows, free its objects (device tier included, so
+        rmt_device_bytes_pinned returns to the pre-job level), then
+        retire its ledger. Idempotent: every step tolerates re-running,
+        and a step that errors (job.sweep fault site) schedules a retry
+        via the heartbeat loop without losing the steps that completed.
+        Returns True when every step completed."""
+        if job_id == self.job_id.binary():
+            return True  # the root job dies with shutdown(), not a sweep
+        from ..utils import faults
+
+        t0 = time.monotonic()
+        ok = True
+
+        def step(fn):
+            nonlocal ok
+            try:
+                act = faults.fire("job.sweep")
+                if act is not None:
+                    if act.mode == "stall":
+                        act.sleep()
+                    else:
+                        act.raise_()
+                fn()
+            except Exception:
+                ok = False
+
+        with self._lock:
+            # close admission first: ledger_for refuses swept jobs, so a
+            # racing submit/put cannot re-charge a job being dismantled
+            self._swept_jobs.add(job_id)
+            led = self._job_ledgers.get(job_id)
+
+        def mark_dead():
+            # clean disconnect finishes the job; a stop request or a
+            # watchdog-detected death (SIGKILL, lost notification) fails it
+            state = {"disconnect": "FINISHED",
+                     "stop": "STOPPED"}.get(trigger, "FAILED")
+            self.gcs.set_job_state(job_id, state, f"swept ({trigger})")
+
+        step(mark_dead)
+
+        def cancel_tasks():
+            dead = RmtError(f"job {job_id.hex()[:8]} died ({trigger})")
+            with self._lock:
+                specs = [rec.spec for rec in self.tasks.values()
+                         if rec.spec.job_id == job_id
+                         and rec.state not in ("FINISHED", "FAILED")]
+                for s in specs:
+                    self._cancelled.add(s.task_id)
+                    self._waiting_deps.pop(s.task_id, None)
+            ids = {s.task_id for s in specs}
+            if led is not None:
+                for s in led.drain_parked():
+                    if s.task_id not in ids:
+                        ids.add(s.task_id)
+                        specs.append(s)
+                    with self._lock:
+                        self._cancelled.add(s.task_id)
+            for nm in list(self.nodes.values()):
+                # queued-but-undispatched: drop from the node queue and
+                # settle any leaf credit the task held
+                with nm._lock:
+                    queued = [s for s in nm.queue if s.task_id in ids]
+                    for s in queued:
+                        try:
+                            nm.queue.remove(s)
+                        except ValueError:
+                            pass
+                        if s.task_id in nm.leaf_local:
+                            nm.leaf_local.discard(s.task_id)
+                            nm.leaf_credits += 1
+                for tid in ids:
+                    # agent-leased leaf: reclaim credit, and have the
+                    # agent kill the pool worker running it (only the
+                    # agent knows the placement)
+                    if nm.finish_leaf(tid) is not None:
+                        nm.cancel_leaf(tid)
+                # running: kill the worker; _on_worker_death releases its
+                # leases and refs, retry lands in _cancelled and fails
+                with nm._lock:
+                    victims = [h for h in nm.workers.values()
+                               if h.actor_id is None
+                               and any(t in ids for t in h.inflight)]
+                for h in victims:
+                    try:
+                        h.proc.terminate()
+                    except Exception:
+                        pass
+            for s in specs:
+                self._fail_task(s, dead)
+
+        step(cancel_tasks)
+
+        def kill_actors():
+            aids = []
+            if led is not None:
+                with led.lock:
+                    aids = list(led.actors)
+            for aid in aids:
+                try:
+                    self.kill_actor(aid, no_restart=True)
+                except Exception:
+                    pass
+
+        step(kill_actors)
+
+        def free_owned():
+            # the job's objects: everything its ledger charged (puts and
+            # device pins) plus every directory row tagged with the job
+            # (store-resident returns) plus its tasks' return ids. The
+            # sweep walks ONLY rows tagged with this job id — a 4-byte
+            # prefix collision with another job can never widen it.
+            owned = set(led.owned_object_ids()) if led is not None else set()
+            owned.update(self.gcs.job_object_keys(job_id))
+            with self._lock:
+                for rec in self.tasks.values():
+                    if rec.spec.job_id == job_id:
+                        owned.update(rec.spec.return_ids)
+            if not owned:
+                return
+            # the dead driver's handles ARE the outstanding refs: drop
+            # the rows so free_objects sees refcount zero
+            for oid in owned:
+                sh = self._ref_stripe(oid)
+                with sh.lock:
+                    sh.refs.pop(oid, None)
+            self.free_objects(list(owned))
+
+        step(free_owned)
+
+        if ok:
+            # every step completed: retire the ledger (kept across failed
+            # attempts so the retry still has the owned-object manifest)
+            if led is not None:
+                led.swept = True
+            with self._lock:
+                self._job_ledgers.pop(job_id, None)
+                mdefs.jobs_active().set(float(len(self._job_ledgers)))
+            self._m_job_sweeps.inc(tags={"trigger": trigger})
+            mdefs.job_sweep_seconds().observe(time.monotonic() - t0)
+            with self._lock:
+                self._sweep_retry.pop(job_id, None)
+        else:
+            with self._lock:
+                self._sweep_retry[job_id] = (
+                    time.monotonic() + self.config.job_sweep_retry_s,
+                    trigger)
+        return ok
+
+    def _pump_sweep_retries(self) -> None:
+        """Heartbeat-loop hook: re-run job sweeps that hit an error
+        (sweeps are idempotent, so re-running is always safe)."""
+        now = time.monotonic()
+        with self._lock:
+            due = [(j, trig) for j, (t, trig)
+                   in self._sweep_retry.items() if t <= now]
+            for j, _ in due:
+                del self._sweep_retry[j]
+        for j, trig in due:
+            self.sweep_job(j, trigger=trig)
+
     # ------------------------------------------------------------ heartbeats
     def _heartbeat_loop(self) -> None:
         interval = self.config.heartbeat_interval_s
@@ -2958,6 +3405,7 @@ class Runtime:
                         self._on_worker_death(h)
             for node_id in self.gcs.check_heartbeats(timeout):
                 self.remove_node(node_id)
+            self._pump_sweep_retries()  # re-run job sweeps that errored
             try:
                 self._refresh_gauges(nodes)
             except Exception:
@@ -3008,7 +3456,8 @@ class Runtime:
         mdefs.device_store_bytes().set(float(self.device_store.total_bytes()))
 
     # --------------------------------------------------------- device objects
-    def put_device_object(self, value: Any) -> bytes:
+    def put_device_object(self, value: Any,
+                          job_id: Optional[bytes] = None) -> bytes:
         """Pin a jax.Array in THIS process's device store (HBM-resident
         ObjectRef — SURVEY.md §7 design; see device_store.py)."""
         from .device_store import is_device_array
@@ -3018,6 +3467,13 @@ class Runtime:
                 "put(..., device=True) requires a jax.Array; got "
                 f"{type(value).__name__}")
         oid = ObjectID.for_put().binary()
+        try:
+            nbytes = int(value.nbytes)
+        except Exception:  # noqa: BLE001
+            nbytes = 0
+        # quota BEFORE any registration: an over-quota pin must touch
+        # nothing (no directory row, no future, no store state)
+        self._admit_job_bytes(job_id, oid, nbytes, device=True)
         with self._lock:
             self._device_locations[oid] = "driver"
             fut = _SlimFuture()
@@ -3026,12 +3482,8 @@ class Runtime:
         # directory first, then the pin: a put over budget demotes LRU
         # entries synchronously, and a demoted sibling's tier flip must
         # not race this object's own registration
-        try:
-            nbytes = int(value.nbytes)
-        except Exception:  # noqa: BLE001
-            nbytes = 0
         self.gcs.add_object_location(oid, self.head_node().node_id,
-                                     size=nbytes, tier="hbm")
+                                     size=nbytes, tier="hbm", job=job_id)
         self.device_store.put(oid, value)
         return oid
 
@@ -3157,6 +3609,7 @@ class Runtime:
             if self._device_locations.get(oid) is handle:
                 del self._device_locations[oid]
             self._demoted_device.add(oid)
+        self._note_job_demotion(oid)  # device quota bytes -> object bytes
 
     def _on_device_consumed(self, handle: WorkerHandle, msg: dict) -> None:
         """A worker took a device entry for donation (consume=True):
@@ -3236,6 +3689,8 @@ class Runtime:
         with self._lock:
             self._device_locations.pop(oid, None)
             self._demoted_device.add(oid)
+        # demoted bytes stop counting against the owner's device quota
+        self._note_job_demotion(oid)
         return True
 
     def _maybe_promote_device(self, oid: bytes, value: Any):
@@ -3289,9 +3744,12 @@ class Runtime:
         return True
 
     # ------------------------------------------------------------ object api
-    def put_object(self, value: Any) -> bytes:
+    def put_object(self, value: Any,
+                   job_id: Optional[bytes] = None) -> bytes:
         data = ser.serialize(value)
         oid = ObjectID.for_put().binary()
+        # quota first: an over-quota put touches neither store nor WAL
+        self._admit_job_bytes(job_id, oid, data.total_size)
         if data.total_size <= self.config.max_direct_call_object_size:
             payload = data.to_bytes()
             with self._lock:
@@ -3310,7 +3768,7 @@ class Runtime:
             nm = self.head_node()
             nm.store.put_serialized(oid, data)
             self.gcs.add_object_location(oid, nm.node_id,
-                                         size=data.total_size)
+                                         size=data.total_size, job=job_id)
         with self._lock:
             fut = _SlimFuture()
             fut.set_result(True)
@@ -3943,6 +4401,8 @@ class Runtime:
             # freed oids leave the sealed WAL too, or a restart would
             # resurrect values every live handle already dropped
             self.gcs.wal_del_sealed(oids)
+        # job plane: uncharge freed bytes from their owners' quotas
+        self._release_job_bytes(oids)
 
     # ------------------------------------------------------ worker requests
     def _serve_worker_request(self, handle: WorkerHandle, msg: dict) -> None:

@@ -21,7 +21,7 @@ class TaskSpec:
         "task_id", "name", "fn_id", "args", "kwargs", "num_returns",
         "return_ids", "resources", "strategy", "max_retries",
         "retry_exceptions", "actor_id", "method", "seq",
-        "runtime_env", "placement", "depth", "trace_ctx",
+        "runtime_env", "placement", "depth", "trace_ctx", "job_id",
         "_ref_deps_cache", "_conda_key", "_req_cache",
     )
 
@@ -45,6 +45,7 @@ class TaskSpec:
         placement: Optional[tuple] = None,  # (pg_id_bytes, bundle_index)
         depth: int = 0,
         trace_ctx: Optional[tuple] = None,  # (trace_id, span_id, parent)
+        job_id: Optional[bytes] = None,
     ):
         self.task_id = task_id
         self.name = name
@@ -64,6 +65,10 @@ class TaskSpec:
         self.placement = placement
         self.depth = depth
         self.trace_ctx = trace_ctx
+        # owning job: the 16-byte id of the job that submitted this task
+        # (the task id's 4-byte prefix is derived from it; the full id
+        # disambiguates prefix collisions for sweeps and state filters)
+        self.job_id = job_id
         self._ref_deps_cache: Optional[List[bytes]] = None
         # memoized conda-env key: computed once at first dispatch, not
         # re-hashed under the node lock every dispatch round

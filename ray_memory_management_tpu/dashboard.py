@@ -11,8 +11,11 @@ Routes::
     /                       HTML overview
     /api/cluster            resources total/available
     /api/nodes|actors|tasks|objects|workers|placement_groups
+                            (tasks/objects take ?job_id= to narrow to
+                            one tenant's rows)
     /api/jobs               job-submission table
-    /api/drivers            GCS job table (driver + client jobs)
+    /api/drivers            GCS job table (driver + client jobs) with
+                            live quota-ledger usage per job
     /api/events             structured cluster events
     /api/task_summary       task-state counts + per-stage latency p50/95/99
     /api/timeline           Chrome traceEvents JSON (load in Perfetto);
@@ -20,10 +23,12 @@ Routes::
     /api/trace?trace_id=    span tree + critical-path attribution
     /api/logs               structured log records + dropped count;
                             filters: ?task_id=&trace_id=&node_id=
-                            &level=&since=&limit= (400 on bad params)
+                            &level=&since=&limit=&job_id=
+                            (400 on bad params)
     /api/profile            folded stack samples + dropped count;
                             filters: ?task_id=&trace_id=&node_id=
-                            &since=&limit=&fold= (400 on bad params)
+                            &since=&limit=&fold=&job_id=
+                            (400 on bad params)
     /metrics                Prometheus exposition text
 """
 
@@ -120,9 +125,9 @@ class Dashboard:
         elif path == "/api/actors":
             data = state.list_actors()
         elif path == "/api/tasks":
-            data = state.list_tasks()
+            data = state.list_tasks(job_id=query.get("job_id"))
         elif path == "/api/objects":
-            data = state.list_objects()
+            data = state.list_objects(job_id=query.get("job_id"))
         elif path == "/api/workers":
             data = state.list_workers()
         elif path == "/api/placement_groups":
@@ -204,7 +209,8 @@ class Dashboard:
                     task_id=query.get("task_id"),
                     trace_id=query.get("trace_id"),
                     node_id=query.get("node_id"),
-                    level=level, since=since, limit=limit),
+                    level=level, since=since, limit=limit,
+                    job_id=query.get("job_id")),
                 # drops since start (worker buffer overflow seen locally
                 # + store retention evictions): non-zero warns the view
                 # is a suffix — mirrors /api/timeline
@@ -242,7 +248,8 @@ class Dashboard:
                     task_id=query.get("task_id"),
                     trace_id=query.get("trace_id"),
                     node_id=query.get("node_id"),
-                    since=since, limit=limit, fold=fold),
+                    since=since, limit=limit, fold=fold,
+                    job_id=query.get("job_id")),
                 # drops since start (sampler aggregation overflow seen
                 # locally + store retention evictions): non-zero warns
                 # the view is a suffix — mirrors /api/logs

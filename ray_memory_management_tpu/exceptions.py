@@ -60,6 +60,25 @@ class NodeDeadError(RmtError):
     its death handler and never again)."""
 
 
+class QuotaExceededError(RmtError):
+    """A job exceeded its admission quota (``JobQuota``). Raised at the
+    admission edge — submit / put / device-pin — never as a side effect
+    of another job's activity. Carries enough context for the caller to
+    decide between backoff, demotion, and giving up."""
+
+    def __init__(self, job_id_hex: str, resource: str,
+                 requested: float, limit: float, used: float):
+        self.job_id_hex = job_id_hex
+        self.resource = resource
+        self.requested = requested
+        self.limit = limit
+        self.used = used
+        super().__init__(
+            f"job {job_id_hex[:8]} over {resource} quota: "
+            f"requested {requested:g} with {used:g}/{limit:g} used"
+        )
+
+
 class GetTimeoutError(RmtError, TimeoutError):
     """``get(timeout=...)`` expired (python/ray/exceptions.py GetTimeoutError)."""
 

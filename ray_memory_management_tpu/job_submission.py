@@ -90,9 +90,62 @@ class JobSubmissionClient:
         })
         return job_id
 
+    @staticmethod
+    def _proc_start_time(pid: int) -> Optional[float]:
+        """The epoch start time of ``pid`` from /proc (Linux), None when
+        unreadable. Field 22 of /proc/<pid>/stat is jiffies-since-boot;
+        boot time comes from /proc/stat btime."""
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                stat = f.read().decode("ascii", "replace")
+            # the comm field may contain spaces/parens: split after the
+            # LAST ')' so field indexing is immune to process names
+            fields = stat[stat.rfind(")") + 2:].split()
+            start_jiffies = int(fields[19])  # field 22 overall
+            with open("/proc/stat", "rb") as f:
+                for line in f:
+                    if line.startswith(b"btime "):
+                        btime = int(line.split()[1])
+                        break
+                else:
+                    return None
+            hz = os.sysconf("SC_CLK_TCK")
+            return btime + start_jiffies / float(hz)
+        except Exception:  # noqa: BLE001 — non-Linux / races
+            return None
+
+    def _pid_is_this_job(self, meta: Dict[str, Any]) -> bool:
+        """Is the recorded pid still THIS job's driver? A SIGKILLed
+        driver frees its pid, and the kernel may hand it to an unrelated
+        process — kill(pid, 0) alone would then report the dead job
+        RUNNING forever. Compare the live process's start time against
+        the job's: a process born after the job was submitted is a pid
+        reuse, not the driver."""
+        pid = meta["pid"]
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            pass  # someone holds the pid; fall through to the birth check
+        started = self._proc_start_time(pid)
+        if started is None:
+            return True  # can't verify: keep the conservative answer
+        # 2s slack: btime/jiffies rounding vs time.time() at submit
+        return started <= meta["start_time"] + 2.0
+
     def _refresh(self, job_id: str) -> Dict[str, Any]:
         meta = self._read_meta(job_id)
         if meta["status"] != RUNNING:
+            # reap a terminal job's subprocess handle: without this the
+            # Popen (and its zombie, if unwaited) lives for the client's
+            # lifetime, and a recycled pid could alias a foreign process
+            proc = self._procs.pop(job_id, None)
+            if proc is not None:
+                try:
+                    proc.wait(timeout=0)
+                except Exception:  # noqa: BLE001
+                    pass
             return meta
         proc = self._procs.get(job_id)
         if proc is not None:
@@ -101,18 +154,17 @@ class JobSubmissionClient:
                 return meta
             meta["status"] = SUCCEEDED if code == 0 else FAILED
             meta["returncode"] = code
+            self._procs.pop(job_id, None)  # reaped by poll()
         else:
-            # job started by another client: liveness via kill(pid, 0).
-            # EPERM means SOME process has the pid (possibly a reuse by
-            # another user) — treat as running rather than crash.
-            try:
-                os.kill(meta["pid"], 0)
+            # job started by another client (or a restarted one): no
+            # Popen handle, so liveness comes from the pid — guarded
+            # against pid reuse by the birth-time check
+            if self._pid_is_this_job(meta):
                 return meta
-            except PermissionError:
-                return meta
-            except ProcessLookupError:
-                meta["status"] = FAILED
-                meta.setdefault("returncode", None)
+            # SIGKILLed / crashed without a clean exit path: the meta
+            # said RUNNING but nothing backs it — fail the job
+            meta["status"] = FAILED
+            meta.setdefault("returncode", None)
         meta["end_time"] = time.time()
         self._write_meta(job_id, meta)
         return meta

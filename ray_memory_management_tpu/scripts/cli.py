@@ -146,6 +146,44 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_jobs(args) -> int:
+    """Live job-plane view of the runtime in THIS process (like
+    ``memory``/``summary``, reads the in-process runtime — call
+    main(['jobs']) from a driver). One row per GCS job (driver + every
+    thin-client connection) with its quota-ledger usage: bytes charged
+    against object/device quotas, cpu slots in use vs parked, priority,
+    and preemption/demotion counters."""
+    from ray_memory_management_tpu import _worker_context, state
+
+    if _worker_context.get_runtime() is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['jobs']))",
+              file=sys.stderr)
+        return 1
+    rows = state.list_jobs()
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+
+    def _mb(n):
+        return f"{(n or 0) / 1e6:8.1f}MB"
+
+    print(f"{'job':16s} {'state':9s} {'prio':>4s} {'slots':>11s} "
+          f"{'obj_bytes':>10s} {'dev_bytes':>10s} {'preempt':>7s}")
+    for row in rows:
+        u = row.get("usage") or {}
+        q = u.get("quota") or {}
+        slots = (f"{u.get('tasks_inflight', 0)}/"
+                 f"{q.get('cpu_slots') or '∞'}"
+                 + (f" (+{u['tasks_parked']}q)"
+                    if u.get("tasks_parked") else ""))
+        print(f"{row['job_id'][:16]:16s} {row.get('state', '?'):9s} "
+              f"{u.get('priority', 1):>4d} {slots:>11s} "
+              f"{_mb(u.get('object_bytes'))} {_mb(u.get('device_bytes'))} "
+              f"{u.get('preempted', 0):>7d}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Span tree + critical-path attribution for one trace of the
     runtime in THIS process (like ``summary``/``memory``, reads the
@@ -433,6 +471,14 @@ def build_parser() -> argparse.ArgumentParser:
         "summary",
         help="task-state counts + per-stage latency p50/p95/p99")
     s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser(
+        "jobs",
+        help="live jobs (driver + thin-client connections) with "
+             "quota-ledger usage: bytes, slots, priority, preemptions")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable JSON rows")
+    s.set_defaults(fn=cmd_jobs)
 
     s = sub.add_parser(
         "trace",

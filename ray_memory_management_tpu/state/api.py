@@ -45,6 +45,14 @@ def _apply_filters(rows: List[Dict[str, Any]],
     return out
 
 
+def _job_task_prefix(job_id: str) -> str:
+    """Task ids (and their return-object ids) embed the owning job's
+    first 4 id bytes (ids.TaskID.for_task), so an 8-hex-char prefix match
+    attributes rows whose full job tag was pruned — task-history tuples,
+    log records, profile samples."""
+    return job_id.lower()[:8]
+
+
 def list_nodes(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     rt = _runtime()
     rows = []
@@ -79,7 +87,8 @@ def list_actors(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     return _apply_filters(rows, filters)[:limit]
 
 
-def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+def list_tasks(filters=None, limit: int = 10000,
+               job_id: Optional[str] = None) -> List[Dict[str, Any]]:
     rt = _runtime()
     with rt._lock:
         records = list(rt.tasks.items())
@@ -100,11 +109,13 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
         "cpu_s": rusage.get("cpu_s") if rusage else None,
         "peak_rss": rusage.get("peak_rss") if rusage else None,
         "hbm_bytes": rusage.get("hbm_bytes") if rusage else None,
+        "job_id": None,  # pruned to the id prefix; see _job_task_prefix
     } for tid, name, state, nret, retries, is_actor, ts, trace_ctx, rusage
         in history]
     for task_id, rec in records:
         tctx = rec.spec.trace_ctx
         ru = rec.rusage
+        jid = getattr(rec.spec, "job_id", None)
         rows.append({
             "task_id": task_id.hex(),
             "name": rec.spec.name,
@@ -119,21 +130,40 @@ def list_tasks(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
             "cpu_s": ru.get("cpu_s") if ru else None,
             "peak_rss": ru.get("peak_rss") if ru else None,
             "hbm_bytes": ru.get("hbm_bytes") if ru else None,
+            "job_id": jid.hex() if jid else None,
         })
+    if job_id is not None:
+        want, pref = job_id.lower(), _job_task_prefix(job_id)
+        rows = [r for r in rows
+                if (r["job_id"] == want if r["job_id"] is not None
+                    else r["task_id"].startswith(pref))]
     return _apply_filters(rows, filters)[:limit]
 
 
-def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
+def list_objects(filters=None, limit: int = 10000,
+                 job_id: Optional[str] = None) -> List[Dict[str, Any]]:
     rt = _runtime()
     rows = []
     with rt._lock:
         mem = {oid: len(data) for oid, data in rt.memory_store.items()}
+    # job attribution: quota ledgers know every byte a client job charged
+    # (including inline memory_store puts the directory never sees); the
+    # directory's jobs table tags store/device rows. An oid in neither is
+    # driver-owned and reports job_id=None.
+    owner_by_oid: Dict[bytes, str] = {}
+    for jid, led in list(getattr(rt, "_job_ledgers", {}).items()):
+        with led.lock:
+            for o in led.object_sizes:
+                owner_by_oid[o] = jid.hex()
+            for o in led.device_sizes:
+                owner_by_oid[o] = jid.hex()
     for oid, size in mem.items():
         rows.append({
             "object_id": oid.hex(),
             "size_bytes": size,
             "where": "memory_store",
             "node_id": None,
+            "job_id": owner_by_oid.get(oid),
         })
     oids = rt.gcs.directory_keys()
     # one batched directory read replaces the old per-(object, node) shm
@@ -164,22 +194,36 @@ def list_objects(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
                 where = "spilled"
             else:
                 where = "store"
+            tag = rt.gcs.object_job(oid)
             rows.append({
                 "object_id": oid.hex(),
                 "size_bytes": size or None,
                 "where": where,
                 "tier": tier,
                 "node_id": node_id.hex(),
+                "job_id": tag.hex() if tag else owner_by_oid.get(oid),
             })
+    if job_id is not None:
+        # explicit tag wins; untagged rows (task returns) match through
+        # the job prefix their minting task id embeds
+        want, pref = job_id.lower(), _job_task_prefix(job_id)
+        rows = [r for r in rows
+                if r["job_id"] == want
+                or (r["job_id"] is None and r["object_id"].startswith(pref))]
     return _apply_filters(rows, filters)[:limit]
 
 
 def list_jobs(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
     """Job table rows (the driver plus every thin-client connection; the
     reference's list_jobs over the GcsJobManager table,
-    gcs_job_manager.h:28)."""
+    gcs_job_manager.h:28). Live jobs carry their quota-ledger ``usage``
+    snapshot (bytes charged, slots, preemption/demotion counters)."""
     rt = _runtime()
-    return _apply_filters(rt.gcs.list_jobs(), filters)[:limit]
+    rows = rt.gcs.list_jobs()
+    usage = rt.job_usage() if hasattr(rt, "job_usage") else {}
+    for row in rows:
+        row["usage"] = usage.get(row.get("job_id"))
+    return _apply_filters(rows, filters)[:limit]
 
 
 def list_workers(filters=None, limit: int = 10000) -> List[Dict[str, Any]]:
@@ -336,7 +380,8 @@ def get_logs(task_id: Optional[str] = None,
              node_id: Optional[str] = None,
              level: Optional[str] = None,
              since: Optional[float] = None,
-             limit: int = 1000) -> List[Dict[str, Any]]:
+             limit: int = 1000,
+             job_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Query the cluster's structured log plane (utils/structlog.py):
     every record a worker/agent/driver process captured — package-logger
     lines, user ``logging`` calls, and teed task ``print()`` output —
@@ -344,14 +389,24 @@ def get_logs(task_id: Optional[str] = None,
     are ANDed; ``level`` is a MINIMUM severity (``"WARNING"`` returns
     WARNING and above), ``since`` an exclusive ts lower bound; the
     newest ``limit`` records return oldest-first. Id filters take hex
-    strings (the ids list_tasks/get_trace rows carry)."""
+    strings (the ids list_tasks/get_trace rows carry); ``job_id``
+    matches records through the job prefix their task id embeds."""
     rt = _runtime()
     store = getattr(rt, "log_store", None)
     if store is None:
         return []
-    return store.query(task_id=task_id, trace_id=trace_id,
-                       node_id=node_id, level=level, since=since,
-                       limit=limit)
+    if job_id is None:
+        return store.query(task_id=task_id, trace_id=trace_id,
+                           node_id=node_id, level=level, since=since,
+                           limit=limit)
+    # job filter is applied here (the store doesn't index jobs): fetch
+    # unbounded so the newest-``limit`` cut happens AFTER narrowing
+    pref = _job_task_prefix(job_id)
+    rows = [r for r in store.query(task_id=task_id, trace_id=trace_id,
+                                   node_id=node_id, level=level,
+                                   since=since, limit=None)
+            if (r.get("task_id") or "").startswith(pref)]
+    return rows[-limit:] if limit > 0 else []
 
 
 def get_profile(node_id: Optional[str] = None,
@@ -359,7 +414,8 @@ def get_profile(node_id: Optional[str] = None,
                 trace_id: Optional[str] = None,
                 since: Optional[float] = None,
                 limit: int = 10000,
-                fold: bool = True):
+                fold: bool = True,
+                job_id: Optional[str] = None):
     """Query the cluster's profiling plane (utils/profiler.py): stack
     samples every worker/agent/driver process captured, stamped with
     node/pid/role/thread/task/trace identity. Filters are ANDed; id
@@ -374,8 +430,19 @@ def get_profile(node_id: Optional[str] = None,
     store = getattr(rt, "profile_store", None)
     if store is None:
         return []
-    samples = store.query(task_id=task_id, trace_id=trace_id,
-                          node_id=node_id, since=since, limit=limit)
+    if job_id is None:
+        samples = store.query(task_id=task_id, trace_id=trace_id,
+                              node_id=node_id, since=since, limit=limit)
+    else:
+        # narrow by the job prefix task ids embed, THEN cut to newest
+        # ``limit`` — same post-filter shape as get_logs(job_id=)
+        pref = _job_task_prefix(job_id)
+        samples = [s for s in store.query(task_id=task_id,
+                                          trace_id=trace_id,
+                                          node_id=node_id, since=since,
+                                          limit=None)
+                   if (s.get("task_id") or "").startswith(pref)]
+        samples = samples[-limit:] if limit > 0 else []
     if not fold:
         return samples
     from ..utils import profiler as _profiler

@@ -37,6 +37,17 @@ control planes::
                        error/drop raise out of handle_request (the
                        caller's ref resolves to the failure); stall
                        inflates service time, exercising shed paths
+    job.detach         driver-disconnect notification  (error/stall/drop):
+                       drop/error loses the disconnect notice at the
+                       cluster server — the job's reclaim never runs on
+                       the connection path and the ORPHANED job must be
+                       found and swept by the job watchdog instead
+    job.sweep          job-death sweep step            (error/stall/drop):
+                       an injected error aborts one sweep step (mark /
+                       cancel-tasks / kill-actors / free-objects); the
+                       sweep reschedules itself via the heartbeat loop —
+                       sweeps are idempotent, so the retry releases
+                       whatever the failed attempt left behind
 
 Each site × mode carries a probability, an optional activation offset
 (``after``: skip the first N hits) and budget (``max``: stop after N
@@ -80,6 +91,7 @@ SITES = (
     "checkpoint.save", "checkpoint.restore",
     "device.materialize", "device.evict",
     "serve.admit", "replica.exec",
+    "job.detach", "job.sweep",
 )
 
 
