@@ -717,6 +717,7 @@ def _scale_suite():
 REQUIRED_SCALE_CURVE_FIELDS = (
     "nodes", "many_tasks_per_s", "many_actors_per_s",
     "tasks_scaling_1_to_4", "actors_scaling_1_to_4",
+    "head_peak_rss_mb", "dir_op_p99_us",
 )
 
 
@@ -746,6 +747,47 @@ def _scale_curve_suite():
         return out
     except Exception as e:  # pragma: no cover - keep the headline alive
         print(f"  scale_curve suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
+REQUIRED_POD_FIELDS = (
+    "nodes", "tasks_per_s", "dir_p50_us", "dir_p99_us", "head_rss_mb",
+    "tasks_scaling_first_to_last", "rows",
+)
+
+
+def _pod_suite():
+    """Pod-scale control plane (ISSUE 19): 8->256 SIMULATED node
+    memberships (protocol-faithful sim agents over the real channels)
+    plus a 10^6-row flood against the memory-bounded directory. Watches
+    tasks/s and directory-op tails across the membership curve, and —
+    for the row flood — that head RSS stays bounded (hot cap + cold
+    spill) while steady-state churn ships O(changes) pong deltas, not
+    full state. Fault-isolated so a failure still reports the rest."""
+    try:
+        from ray_memory_management_tpu.utils.pod_bench import run_pod_curve
+
+        out = run_pod_curve()
+        for metric in ("tasks_per_s", "dir_p99_us", "head_rss_mb"):
+            pts = out.get(metric, {})
+            curve = "  ".join(f"{n}n:{pts[str(n)]:.1f}"
+                              for n in out["nodes"] if str(n) in pts)
+            print(f"  pod_curve {metric:20s} {curve}", file=sys.stderr)
+        rows = out.get("rows", {})
+        if rows:
+            print(f"  pod_curve rows {rows.get('total', 0):.0f} "
+                  f"(hot {rows.get('hot', 0):.0f} / cold "
+                  f"{rows.get('cold', 0):.0f}) rss "
+                  f"{rows.get('rss_mb_at_rows', 0):.1f}MB, "
+                  f"churn shipped {rows.get('churn_rows_shipped', 0):.0f} "
+                  f"rows, full pongs {rows.get('full_pongs', 0):.0f}",
+                  file=sys.stderr)
+        missing = [k for k in REQUIRED_POD_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  pod suite failed: {e!r}", file=sys.stderr)
         return {"error": repr(e)}
 
 
@@ -849,6 +891,7 @@ def main() -> None:
     jobs = _jobs_suite()
     scale = _scale_suite()
     scale_curve = _scale_curve_suite()
+    pod = _pod_suite()
     tpu = _tpu_suite()
 
     # Full detail goes to a file plus its own EARLIER stdout lines; the
@@ -856,7 +899,7 @@ def main() -> None:
     # always captures the headline (round 4's single giant line outgrew
     # that window and the whole round parsed as null).
     detail = {"micro_stats": stats, "scale": scale,
-              "scale_curve": scale_curve, "tpu": tpu,
+              "scale_curve": scale_curve, "pod": pod, "tpu": tpu,
               "transfer": transfer, "compression": compression,
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
@@ -870,7 +913,7 @@ def main() -> None:
             json.dump(detail, f, indent=1, sort_keys=True)
     except OSError as e:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
-    for section in ("micro_stats", "scale", "scale_curve", "tpu",
+    for section in ("micro_stats", "scale", "scale_curve", "pod", "tpu",
                     "transfer", "compression", "locality", "device",
                     "tracing", "logging", "profile", "elastic",
                     "serve", "jobs", "metrics"):
@@ -882,14 +925,14 @@ def main() -> None:
                         tpu, transfer, locality, tracing, elastic,
                         compression, logging=logging_out, device=device,
                         profile=profile, scale_curve=scale_curve,
-                        serve=serve, jobs=jobs))
+                        serve=serve, jobs=jobs, pod=pod))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
                   device=None, profile=None, scale_curve=None,
-                  serve=None, jobs=None):
+                  serve=None, jobs=None, pod=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -916,6 +959,29 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             "tasks_per_s": scale_curve["many_tasks_per_s"],
             "tasks_scaling_1_to_4": scale_curve["tasks_scaling_1_to_4"],
             "actors_scaling_1_to_4": scale_curve["actors_scaling_1_to_4"],
+        }
+        # per-point head RSS and directory-op tails (absent in rounds
+        # that predate them — the perf gate simply doesn't vote then)
+        for k in ("head_peak_rss_mb", "dir_op_p99_us"):
+            if scale_curve.get(k):
+                line["scale_curve"][k] = scale_curve[k]
+    if pod and "error" not in pod:
+        # the pod-scale acceptance numbers: tasks/s at the smallest and
+        # largest membership, directory-op tail and head RSS at the
+        # largest, and the row flood's bound + O(changes) evidence
+        nodes = pod["nodes"]
+        f, l = str(nodes[0]), str(nodes[-1])
+        rows = pod.get("rows", {})
+        line["pod_curve"] = {
+            "nodes_max": nodes[-1],
+            f"tasks_per_s_{f}": round(pod["tasks_per_s"].get(f, 0), 1),
+            f"tasks_per_s_{l}": round(pod["tasks_per_s"].get(l, 0), 1),
+            f"dir_p99_us_{l}": round(pod["dir_p99_us"].get(l, 0), 1),
+            f"head_rss_mb_{l}": round(pod["head_rss_mb"].get(l, 0), 1),
+            "rows_total": rows.get("total", 0),
+            "rows_rss_mb": round(rows.get("rss_mb_at_rows", 0), 1),
+            "rows_full_pongs": rows.get("full_pongs", 0),
+            "rows_churn_shipped": rows.get("churn_rows_shipped", 0),
         }
     micro = {k: stats[k]["median"] for k in
              ("single_client_tasks_sync", "single_client_tasks_async",
@@ -1042,7 +1108,7 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
         for k in ("jobs", "serve", "profile", "compression", "elastic",
                   "logging", "tracing", "device", "locality", "transfer",
-                  "micro", "scale_curve", "scale"):
+                  "micro", "pod_curve", "scale_curve", "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

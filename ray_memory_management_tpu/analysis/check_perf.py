@@ -54,6 +54,15 @@ FIELD_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("scale_curve.tasks_per_s.1", "up", 0.35),
     ("scale_curve.tasks_per_s.4", "up", 0.35),
     ("scale_curve.tasks_scaling_1_to_4", "up", 0.25),
+    # pod-scale control plane (ISSUE 19): task throughput at the
+    # smallest and largest SIM membership must not collapse; the
+    # directory-op tail, head RSS at 256 nodes, and the row flood's
+    # RSS bound get absolute slack (us / MB of creep over baseline)
+    ("pod_curve.tasks_per_s_8", "up", 0.40),
+    ("pod_curve.tasks_per_s_256", "up", 0.45),
+    ("pod_curve.dir_p99_us_256", "down", 800.0),
+    ("pod_curve.head_rss_mb_256", "down", 768.0),
+    ("pod_curve.rows_rss_mb", "down", 768.0),
     ("tpu.train_tokens_per_s", "up", 0.35),
     ("tpu.train_mfu", "up", 0.35),
     # serving data plane (ISSUE 17): tail latency must not creep, the

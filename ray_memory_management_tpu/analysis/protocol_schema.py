@@ -32,10 +32,15 @@ REPLY_KEYS = (
 
 # observability piggyback frames: worker flush frame + agent pong
 FRAME_KEYS = (
+    "dadd",
+    "ddel",
+    "dfull",
     "events",
     "logs",
     "profile",
     "samples",
+    "seq",
     "series",
+    "stat",
     "type",
 )

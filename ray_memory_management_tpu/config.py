@@ -180,7 +180,32 @@ _flag("gcs_directory_shards", int, 0,
       "so directory updates and free batches from different nodes never "
       "contend on one lock (the reference shards its GCS tables the same "
       "way, gcs_table_storage.h). 0 = auto (cpu_count, clamped to "
-      "[4, 64]).")
+      "[4, gcs_directory_shards_max]).")
+_flag("gcs_directory_shards_max", int, 64,
+      "Upper clamp for AUTO directory-shard resolution. 64 shards stop "
+      "paying off around 8 virtual nodes; pod-scale runs (64-256 node "
+      "memberships) raise this so add/locate traffic from hundreds of "
+      "agent channels keeps striping instead of re-serializing.")
+_flag("gcs_directory_hot_max_rows", int, 1_000_000,
+      "Hot-row budget for the GCS object directory, split evenly across "
+      "shards. Rows beyond the per-shard share spill COLD (LRU within "
+      "shard): holder set / size / tier map serialize in batches to the "
+      "gcs_storage blob surface and fault back in transparently on "
+      "locate, so head RSS stays bounded at millions of rows instead of "
+      "growing ~1KB per live object. <=0 disables spilling (every row "
+      "stays RAM-resident).")
+_flag("gcs_directory_cold_s", float, 5.0,
+      "A directory row is a spill candidate once it has not been "
+      "located, renewed, or mutated for this long. The hard hot-row cap "
+      "wins over recency: an over-budget shard spills its LRU tail even "
+      "if some of it is younger than this.")
+_flag("leaf_lease_batch", int, 64,
+      "Max leaf-lease grants coalesced into one lease_batch frame per "
+      "node per scheduling pass. The leaf fast path buffers grants "
+      "head-side and flushes one frame per node instead of one frame "
+      "per task, so per-node control ingress is O(flushes), not "
+      "O(tasks). 1 disables coalescing (every grant ships alone, the "
+      "pre-batching wire behavior).")
 _flag("leaf_lease_slots", int, 0,
       "Execution-lease credits granted in bulk per node for LEAF tasks "
       "(no placement group / affinity / runtime_env, <=1 CPU, no TPU): "

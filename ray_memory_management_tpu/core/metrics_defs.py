@@ -198,6 +198,29 @@ DEFS: Dict[str, tuple] = {
     "rmt_object_directory_prunes_total": (Counter, dict(
         description="Stale GCS object-directory locations pruned after a "
                     "holder reported the object missing.")),
+    # pod-scale control plane (hot/cold directory + delta heartbeats):
+    # the memory bound and the O(changes) ingress claim are measurable,
+    # not just asserted by the pod bench
+    "rmt_gcs_directory_hot_rows": (Gauge, dict(
+        description="RAM-resident GCS object-directory rows across "
+                    "shards (bounded by gcs_directory_hot_max_rows).")),
+    "rmt_gcs_directory_cold_rows": (Gauge, dict(
+        description="Directory rows spilled to the gcs_storage blob "
+                    "surface; only their per-oid index entry stays in "
+                    "head RAM.")),
+    "rmt_gcs_directory_faults_total": (Counter, dict(
+        description="Cold directory batches faulted back into the hot "
+                    "tables on a locate/mutation of a spilled row.")),
+    "rmt_gcs_directory_spills_total": (Counter, dict(
+        description="Directory LRU-tail batches spilled to the "
+                    "gcs_storage blob surface by the hot-row cap.")),
+    "rmt_heartbeat_resyncs_total": (Counter, dict(
+        description="Full-state heartbeat resyncs requested after a "
+                    "delta-pong sequence gap or reconnect.")),
+    "rmt_leaf_lease_batches_total": (Counter, dict(
+        description="lease_batch frames flushed (leaf grants coalesced "
+                    "per node per scheduling pass instead of one frame "
+                    "per task).")),
     # elastic train plane (checkpoint/restore/resize — the preemption-
     # tolerance instrument set: a training run's durability overhead and
     # recovery behavior are countable, not just visible in wall-clock)
@@ -549,6 +572,30 @@ def stale_creates_aborted() -> Counter:
 
 def object_directory_prunes() -> Counter:
     return get("rmt_object_directory_prunes_total")
+
+
+def gcs_directory_hot_rows() -> Gauge:
+    return get("rmt_gcs_directory_hot_rows")
+
+
+def gcs_directory_cold_rows() -> Gauge:
+    return get("rmt_gcs_directory_cold_rows")
+
+
+def gcs_directory_faults() -> Counter:
+    return get("rmt_gcs_directory_faults_total")
+
+
+def gcs_directory_spills() -> Counter:
+    return get("rmt_gcs_directory_spills_total")
+
+
+def heartbeat_resyncs() -> Counter:
+    return get("rmt_heartbeat_resyncs_total")
+
+
+def leaf_lease_batches() -> Counter:
+    return get("rmt_leaf_lease_batches_total")
 
 
 def sched_local_placed() -> Counter:

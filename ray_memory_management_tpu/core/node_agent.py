@@ -187,6 +187,17 @@ class NodeAgent:
         # fn blobs ship once per NODE (head-side lease_known_fns); the
         # agent re-attaches from this cache per WORKER as needed
         self._lease_fn_blobs: Dict[bytes, bytes] = {}  # guarded-by: _lock
+        # delta-compressed heartbeats: each pong carries a sequence
+        # number and only the status keys (and held-row deltas, for
+        # agents that own rows — see _dir_report) that changed since the
+        # last pong we SENT; the head applies them in seq order and asks
+        # for full state via the ping's resync flag when it detects a
+        # gap. Committed only after a successful send so the delta base
+        # is exactly the stream the head holds. Recv-loop-private: the
+        # ping handler is the only reader and writer, so no lock guards
+        # these.
+        self._hb_seq = 0
+        self._hb_stat_sent: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # The object plane runs on its OWN thread: a push/ensure into a
@@ -781,6 +792,34 @@ class NodeAgent:
         finally:
             self._shutdown()
 
+    def _hb_status(self) -> Dict[str, Any]:
+        """O(1) agent status snapshot for the pong delta stream (store
+        bytes, lease depth, worker count). The head mirrors the merged
+        dict per node, so steady-state pongs usually carry NO status at
+        all — only the keys that moved since the last acked pong."""
+        used = cap = 0
+        try:
+            u = self.store.usage()
+            used, cap = int(u[0]), int(u[1])
+        except Exception:  # noqa: BLE001 — status must never kill a pong
+            pass
+        with self._lock:
+            depth = sum(self._lease_inflight.values())
+            workers = len(self._workers)
+        return {"store_used": used, "store_cap": cap,
+                "spilled": self.store.spilled_count(),
+                "lease_depth": depth, "workers": workers}
+
+    def _dir_report(self, full: bool):
+        """Held-row delta report ``(dadd, ddel)`` for agents that OWN
+        directory rows, or None. The real agent returns None: its rows
+        are maintained authoritatively by the head's done/free paths,
+        so re-asserting them every pong would burn exactly the ingress
+        the delta plane exists to avoid. The simulated agent plane
+        (utils/sim_agent.py) overrides this to drive pod-scale row
+        churn through the same wire frames."""
+        return None
+
     def _run_loop(self) -> None:
         while True:
             try:
@@ -805,6 +844,13 @@ class NodeAgent:
                         pass  # reader thread will report wdeath
             elif t == "lease_exec":
                 self._lease_exec(msg)
+            elif t == "lease_batch":
+                # per-node coalesced leaf grants (head-side flush_leases):
+                # one frame carries a scheduling pass's worth of leases;
+                # each entry takes the same worker-pick path as a lone
+                # lease_exec, spilling/failing individually
+                for sub in msg["tasks"]:
+                    self._lease_exec(sub)
             elif t == "start_worker":
                 self._start_worker(msg)
             elif t == "kill_worker":
@@ -929,6 +975,33 @@ class NodeAgent:
                     pong["logs"] = lgs
                 if smp:
                     pong["samples"] = smp
+                # delta-compressed control state: ship only the status
+                # keys that changed since the last pong we sent. The
+                # pings are pipelined — the head's ack naturally lags a
+                # round trip behind our committed seq, so a stale ack is
+                # NOT a desync signal (treating it as one degenerates to
+                # full pongs under load). The head detects real gaps
+                # itself (seq != hb_seq+1) and raises the explicit
+                # resync flag, which is the only full-state trigger.
+                stat = self._hb_status()
+                seq = self._hb_seq + 1
+                pong["seq"] = seq
+                full = bool(msg.get("resync"))
+                if full:
+                    pong["stat"] = stat
+                    pong["dfull"] = True
+                else:
+                    delta = {k: v for k, v in stat.items()
+                             if self._hb_stat_sent.get(k) != v}
+                    if delta:
+                        pong["stat"] = delta
+                rep = self._dir_report(full)
+                if rep is not None:
+                    dadd, ddel = rep
+                    if dadd or full:
+                        pong["dadd"] = dadd
+                    if ddel:
+                        pong["ddel"] = ddel
                 try:
                     self._send(pong)
                 except (OSError, BrokenPipeError):
@@ -941,6 +1014,11 @@ class NodeAgent:
                     if smp:
                         _profiler.reingest(smp)
                     return
+                # commit AFTER the successful send: a failed send means
+                # the head never saw seq, its next ack still names the
+                # old epoch, and the delta base stays exact
+                self._hb_seq = seq
+                self._hb_stat_sent = stat
             elif t == "shutdown":
                 return
 

@@ -496,6 +496,14 @@ class NodeManager:
             self.queue.append(spec)
         return True
 
+    def flush_leases(self) -> list:
+        """Local nodes dispatch leaf tasks straight onto their own queue
+        in submit_leaf — there is no grant buffer to flush and nothing
+        can fail, so the router's per-pass flush is a no-op here. The
+        remote override ships the buffered lease_batch frames and
+        returns any specs a dead channel bounced."""
+        return []
+
     def finish_leaf(self, task_id: bytes) -> Optional[TaskSpec]:
         """Settle an agent-placed leaf task (done reply, spillback, or
         worker death): return its credit and hand back the spec. Local

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from ..config import Config
 from ..ids import NodeID, WorkerID
@@ -164,6 +164,19 @@ class RemoteNodeManager(NodeManager):
         # serializes pushes so two transfer threads never interleave
         # create/chunk/seal frames for the same object at the agent
         self._push_lock = threading.Lock()
+        # delta-heartbeat state, head side: seq of the last pong whose
+        # delta we APPLIED (acked on the next ping so the agent knows
+        # which base to delta against), the merged status mirror those
+        # deltas build, and the resync latch a sequence gap raises so
+        # the next ping requests full state
+        self.hb_seq = 0  # guarded-by: _lock
+        self.hb_resync = False  # guarded-by: _lock
+        self.agent_stat: Dict[str, Any] = {}  # guarded-by: _lock
+        # leaf-lease grant buffer: submit_leaf queues built frames here
+        # and the router's per-pass flush ships ONE lease_batch frame
+        # per node (leaf_lease_batch caps a single frame) instead of one
+        # lease_exec per task
+        self._lease_buf: List[dict] = []  # guarded-by: _lock
 
     # ---------------------------------------------------------------- channel
     def channel_send(self, msg: dict) -> bool:
@@ -403,13 +416,123 @@ class RemoteNodeManager(NodeManager):
             self.leaf_credits -= 1
             self.leaf_inflight[spec.task_id] = spec
         msg = build_msg(self, spec)
-        if not self.channel_send({"type": "lease_exec",
-                                  "task_id": spec.task_id, "msg": msg}):
-            with self._lock:
+        # grants BUFFER instead of shipping one frame per task: the
+        # router flushes once per scheduling pass (flush_leases), so a
+        # pass that places N leaf tasks on this node costs one
+        # lease_batch frame, not N lease_exec frames — the per-node
+        # ingress term the pod bench measures. A flush-time send failure
+        # rolls the credits back there; a death between buffer and flush
+        # reroutes through take_leaf_inflight like any in-flight lease.
+        with self._lock:
+            if not self.alive:
                 self.leaf_credits += 1
                 self.leaf_inflight.pop(spec.task_id, None)
-            return False
+                return False
+            self._lease_buf.append({"task_id": spec.task_id, "msg": msg})
         return True
+
+    def flush_leases(self) -> list:
+        """Ship every buffered leaf grant: lease_batch frames of up to
+        leaf_lease_batch entries each; a lone grant keeps the scalar
+        lease_exec frame (wire-identical to pre-batching traffic at low
+        rates). On a send failure the unsent grants' credits roll back
+        and their specs return to the caller for rerouting (the router
+        rides them through _pending_schedule, like a lease_spill)."""
+        with self._lock:
+            if not self._lease_buf:
+                return []
+            buf, self._lease_buf = self._lease_buf, []
+        cap = max(1, int(getattr(self.config, "leaf_lease_batch", 64) or 1))
+        failed: list = []
+        i = 0
+        while i < len(buf):
+            chunk = buf[i:i + cap]
+            i += cap
+            if len(chunk) == 1:
+                ok = self.channel_send({"type": "lease_exec",
+                                        "task_id": chunk[0]["task_id"],
+                                        "msg": chunk[0]["msg"]})
+            else:
+                ok = self.channel_send({"type": "lease_batch",
+                                        "tasks": chunk})
+                if ok:
+                    from . import metrics_defs as mdefs
+
+                    mdefs.leaf_lease_batches().inc()
+            if not ok:
+                with self._lock:
+                    for entry in chunk + buf[i:]:
+                        self.leaf_credits += 1
+                        spec = self.leaf_inflight.pop(entry["task_id"],
+                                                      None)
+                        if spec is not None:
+                            failed.append(spec)
+                break
+        return failed
+
+    def lease_buffered(self) -> int:
+        with self._lock:
+            return len(self._lease_buf)
+
+    # ---------------------------------------------------------- heartbeats
+    def ping_frame(self) -> dict:
+        """The head half of the delta-heartbeat pair: ack the last pong
+        seq whose delta we applied (the agent deltas against exactly
+        that base) and carry the resync latch when a gap lost it."""
+        with self._lock:
+            frame = {"type": "ping", "ack": self.hb_seq}
+            if self.hb_resync:
+                frame["resync"] = True
+        return frame
+
+    def on_pong_delta(self, msg: dict) -> None:
+        """Apply one pong's delta-compressed control state. An in-order
+        seq keeps the merged status mirror exact and applies held-row
+        deltas (dadd/ddel) to the object directory; a full snapshot
+        (dfull) replaces the mirror and reconciles the node's directory
+        rows; a gap raises the resync latch — deltas built on a base we
+        lost are DISCARDED, never guessed at — and is counted."""
+        seq = msg.get("seq")
+        if seq is None:
+            return  # pre-delta pong: nothing to track
+        full = bool(msg.get("dfull"))
+        accept = False
+        resync_now = False
+        with self._lock:
+            if full or seq == self.hb_seq + 1:
+                accept = True
+                self.hb_seq = seq
+                if full:
+                    self.agent_stat = dict(msg.get("stat") or {})
+                    self.hb_resync = False
+                elif msg.get("stat"):
+                    self.agent_stat.update(msg["stat"])
+            elif not self.hb_resync:
+                self.hb_resync = True
+                resync_now = True
+        if resync_now:
+            from . import metrics_defs as mdefs
+
+            mdefs.heartbeat_resyncs().inc()
+            return
+        if not accept or self.gcs is None:
+            return
+        dadd = msg.get("dadd")
+        ddel = msg.get("ddel")
+        if full:
+            if dadd is None:
+                return  # status-only resync: no row assertion to apply
+            held = {oid: size for oid, size in dadd}
+            for oid, size in held.items():
+                self.gcs.add_object_location(oid, self.node_id,
+                                             size=size or None)
+            self.gcs.reconcile_node_rows(self.node_id, held)
+        else:
+            for oid, size in dadd or ():
+                self.gcs.add_object_location(oid, self.node_id,
+                                             size=size or None)
+            for oid in ddel or ():
+                self.gcs.remove_object_location(oid, self.node_id)
 
     def cancel_leaf(self, task_id: bytes) -> None:
         """Job sweep: a leased task of a dead job may be RUNNING on a

@@ -387,7 +387,10 @@ class Runtime:
         from .gcs_storage import open_storage
 
         self.gcs = GCS(open_storage(config.gcs_storage_path),
-                       directory_shards=config.gcs_directory_shards)
+                       directory_shards=config.gcs_directory_shards,
+                       hot_max_rows=config.gcs_directory_hot_max_rows,
+                       cold_s=config.gcs_directory_cold_s,
+                       shards_max=config.gcs_directory_shards_max)
         import sys as _sys
 
         self.gcs.register_job(self.job_id.binary(), {
@@ -983,6 +986,11 @@ class Runtime:
 
             _structlog.ingest(msg.get("logs"))
             _profiler.ingest(msg.get("samples"))
+            # delta-compressed control state rides the same reply:
+            # status-key deltas merge into the node's head-side mirror
+            # and held-row deltas (sim plane) land in the directory;
+            # a seq gap raises the resync latch for the next ping
+            nm.on_pong_delta(msg)
 
     def _bind_remote_worker(self, nm, handle: WorkerHandle) -> None:
         from .remote_node import VirtualConn
@@ -2208,8 +2216,19 @@ class Runtime:
             for spec in batch:
                 self._schedule(spec, pump=False,
                                locality=loc_by_task.get(spec.task_id, {}))
+        bounced = False
         for nm in list(self.nodes.values()):
+            # ship this pass's buffered leaf grants: one lease_batch
+            # frame per node instead of one lease_exec per task. Specs a
+            # broken channel bounced reroute like a lease_spill.
+            for spec in nm.flush_leases():
+                self._m_leaf_spill.inc()
+                with self._lock:
+                    self._pending_schedule.append(spec)
+                bounced = True
             self._pump_node(nm)
+        if bounced:
+            self._wakeup()
 
     def _pump_node(self, nm: NodeManager) -> None:
         nm.try_dispatch(self._send_task)
@@ -3372,8 +3391,11 @@ class Runtime:
                     continue
                 if hasattr(nm, "channel_send"):
                     # remote node: liveness = the agent channel accepting
-                    # writes (EOF/half-open shows up here or at the router)
-                    if nm.channel_send({"type": "ping"}):
+                    # writes (EOF/half-open shows up here or at the
+                    # router). The frame acks the last applied pong seq
+                    # so the agent's reply carries only changes since
+                    # (delta heartbeats — O(changes) ingress per node)
+                    if nm.channel_send(nm.ping_frame()):
                         self.gcs.heartbeat(nm.node_id)
                 else:
                     self.gcs.heartbeat(nm.node_id)
@@ -3439,13 +3461,20 @@ class Runtime:
             if not nm.alive:
                 continue
             nid = nm.node_id.hex()[:12]
-            store = getattr(nm, "store", None)
-            if store is not None and hasattr(store, "usage"):
-                try:
-                    used = store.usage()[0]
-                    store_g.set(float(used), tags={"node_id": nid})
-                except Exception:
-                    pass
+            stat = getattr(nm, "agent_stat", None)
+            if stat:
+                # remote node: the delta-heartbeat mirror already holds
+                # the agent's store bytes — no channel round trip
+                store_g.set(float(stat.get("store_used", 0)),
+                            tags={"node_id": nid})
+            else:
+                store = getattr(nm, "store", None)
+                if store is not None and hasattr(store, "usage"):
+                    try:
+                        used = store.usage()[0]
+                        store_g.set(float(used), tags={"node_id": nid})
+                    except Exception:
+                        pass
             info = self.gcs.nodes.get(nm.node_id)
             if info is not None:
                 hb_g.set(max(0.0, now_mono - info.last_heartbeat),
@@ -3454,6 +3483,9 @@ class Runtime:
             pending = len(self._waiting_deps)
         mdefs.scheduler_pending_args().set(float(pending))
         mdefs.device_store_bytes().set(float(self.device_store.total_bytes()))
+        dstats = self.gcs.directory_stats()
+        mdefs.gcs_directory_hot_rows().set(float(dstats["hot"]))
+        mdefs.gcs_directory_cold_rows().set(float(dstats["cold"]))
 
     # --------------------------------------------------------- device objects
     def put_device_object(self, value: Any,

@@ -48,6 +48,15 @@ control planes::
                        sweep reschedules itself via the heartbeat loop —
                        sweeps are idempotent, so the retry releases
                        whatever the failed attempt left behind
+    directory.spill    cold directory-batch write      (error/stall/drop):
+                       a failed spill degrades to RAM-resident — the
+                       batch's rows stay hot (counted, backed off) and
+                       are NEVER lost; stall delays the write under the
+                       shard lock, exercising hot-path latency
+    directory.fault    cold directory-batch read       (error/stall/drop):
+                       a failed fault-in is a MISS, not a loss — the
+                       blob and the cold index stay intact, the locate
+                       simply omits the row until a retry succeeds
 
 Each site × mode carries a probability, an optional activation offset
 (``after``: skip the first N hits) and budget (``max``: stop after N
@@ -92,6 +101,7 @@ SITES = (
     "device.materialize", "device.evict",
     "serve.admit", "replica.exec",
     "job.detach", "job.sweep",
+    "directory.spill", "directory.fault",
 )
 
 

@@ -56,9 +56,13 @@ def run_scale_curve(node_counts=(1, 2, 4, 8), per_node_cpus=2,
     structure survives a JSON round trip unchanged)."""
     import ray_memory_management_tpu as rmt
 
+    import resource
+
     curve_nodes = list(node_counts)
     tasks_pts: Dict[str, float] = {}
     actors_pts: Dict[str, float] = {}
+    rss_pts: Dict[str, float] = {}
+    dir_p99_pts: Dict[str, float] = {}
     stats = {"many_tasks_per_s": {}, "many_actors_per_s": {}}
     for n in curve_nodes:
         rt = rmt.init(num_cpus=per_node_cpus, num_nodes=n,
@@ -119,6 +123,26 @@ def run_scale_curve(node_counts=(1, 2, 4, 8), per_node_cpus=2,
                 time.sleep(0.3)
             stats["many_actors_per_s"][str(n)] = _median_row(rates)
             actors_pts[str(n)] = stats["many_actors_per_s"][str(n)]["median"]
+
+            # per-point head memory + directory-op tail: the control
+            # plane's two scaling liabilities alongside its throughput
+            # (pod_bench carries the same pair out to 256 sim nodes)
+            rss_pts[str(n)] = round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+                1)
+            import os as _os
+
+            nid = next(iter(rt.nodes))
+            durs = []
+            for i in range(1000):
+                oid = b"scalecurve" + i.to_bytes(4, "big") + _os.urandom(4)
+                t0 = time.perf_counter()
+                rt.gcs.add_object_location(oid, nid, size=64)
+                rt.gcs.locate_objects([oid])
+                rt.gcs.remove_object_location(oid, nid)
+                durs.append((time.perf_counter() - t0) * 1e6)
+            durs.sort()
+            dir_p99_pts[str(n)] = round(durs[(len(durs) * 99) // 100], 1)
         finally:
             rmt.shutdown()
 
@@ -126,6 +150,8 @@ def run_scale_curve(node_counts=(1, 2, 4, 8), per_node_cpus=2,
         "nodes": curve_nodes,
         "many_tasks_per_s": {k: round(v, 1) for k, v in tasks_pts.items()},
         "many_actors_per_s": {k: round(v, 1) for k, v in actors_pts.items()},
+        "head_peak_rss_mb": rss_pts,
+        "dir_op_p99_us": dir_p99_pts,
         "stats": {m: {k: {kk: round(vv, 2) for kk, vv in row.items()}
                       for k, row in pts.items()}
                   for m, pts in stats.items()},

@@ -614,6 +614,85 @@ def test_scale_curve_required_fields(bench):
     assert {"median", "min", "max", "trials"} <= set(row)
 
 
+def test_headline_line_carries_pod_curve_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    pod = {
+        "nodes": [8, 64, 128, 256],
+        "tasks_per_s": {"8": 2900.0, "64": 2400.0, "128": 2100.0,
+                        "256": 1800.0},
+        "dir_p50_us": {"8": 4.0, "64": 5.0, "128": 6.0, "256": 8.0},
+        "dir_p99_us": {"8": 20.0, "64": 40.0, "128": 80.0, "256": 160.0},
+        "head_rss_mb": {"8": 210.0, "64": 240.0, "128": 280.0,
+                        "256": 340.0},
+        "tasks_scaling_first_to_last": 0.62,
+        "rows": {"target": 1_000_000, "total": 1_000_192, "hot": 200_000,
+                 "cold": 800_192, "rss_mb_at_rows": 410.0, "faults": 12,
+                 "spills": 900, "resyncs": 0, "full_pongs": 0,
+                 "delta_pongs": 5120, "churn_rows_shipped": 19984},
+    }
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, pod=pod)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "pod_curve" in line:  # may be popped only by the <1KB guard
+        # first/last points carry the perf-gate field names verbatim
+        assert line["pod_curve"]["nodes_max"] == 256
+        assert line["pod_curve"]["tasks_per_s_8"] == 2900.0
+        assert line["pod_curve"]["tasks_per_s_256"] == 1800.0
+        assert line["pod_curve"]["dir_p99_us_256"] == 160.0
+        assert line["pod_curve"]["head_rss_mb_256"] == 340.0
+        assert line["pod_curve"]["rows_total"] == 1_000_192
+        assert line["pod_curve"]["rows_rss_mb"] == 410.0
+        assert line["pod_curve"]["rows_full_pongs"] == 0
+
+
+def test_headline_line_drops_errored_pod_curve(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, pod={"error": "boom"})
+    assert "pod_curve" not in json.loads(payload)
+
+
+def test_bench_detail_snapshot_has_pod_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the pod section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    pod = detail.get("pod")
+    if pod is None:
+        pytest.skip("snapshot predates the pod section")
+    if "error" not in pod:
+        missing = [k for k in bench.REQUIRED_POD_FIELDS if k not in pod]
+        assert not missing, missing
+
+
+@pytest.mark.slow
+def test_pod_curve_required_fields(bench):
+    """A mini pod curve end-to-end (real sim agents over real channels,
+    real row flood against the bounded directory): every REQUIRED field
+    present, per-point dicts keyed by stringified node count, and the
+    flood's convergence/bound evidence populated."""
+    from ray_memory_management_tpu.utils.pod_bench import run_pod_curve
+
+    out = run_pod_curve(node_counts=(2, 4), tasks_per_point=80,
+                        rows_target=3000, hot_max_rows=512,
+                        rows_per_agent_chunk=250)
+    missing = [k for k in bench.REQUIRED_POD_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["nodes"] == [2, 4]
+    assert set(out["tasks_per_s"]) == {"2", "4"}
+    assert all(v > 0 for v in out["tasks_per_s"].values())
+    assert all(v > 0 for v in out["dir_p99_us"].values())
+    assert all(v > 0 for v in out["head_rss_mb"].values())
+    rows = out["rows"]
+    assert rows["total"] >= rows["target"] == 3000
+    assert rows["cold"] > 0  # the hot cap engaged during the flood
+    assert rows["rss_mb_at_rows"] > 0
+
+
 def test_headline_line_carries_serve_summary(bench):
     results, stats, ratios, scale, tpu = _bloated_inputs()
     serve = {"p99_ms": 41.7, "tokens_per_s_per_chip": 512.3,

@@ -562,3 +562,72 @@ def test_worker_exec_fault_rides_task_retry():
         assert rmt.get(answer.remote(), timeout=120) == 42
     finally:
         rmt.shutdown()
+
+
+# --- directory hot/cold failure matrix ---------------------------------------
+
+def _bounded_gcs(hot_max_rows=64, shards=4):
+    """A GCS whose directory spills aggressively: per-shard hot cap at
+    the floor (16) and cold_s=0 so every untouched row is a candidate."""
+    from ray_memory_management_tpu.core.gcs import GCS
+    from ray_memory_management_tpu.core.gcs_storage import InMemoryGcsStorage
+
+    return GCS(InMemoryGcsStorage(), directory_shards=shards,
+               hot_max_rows=hot_max_rows, cold_s=0.0)
+
+
+def _fill_directory(g, node, n=500):
+    oids = [b"dirflt" + i.to_bytes(4, "big") + bytes(10) for i in range(n)]
+    for oid in oids:
+        g.add_object_location(oid, node, size=64)
+    return oids
+
+
+def test_directory_spill_failure_degrades_to_ram_never_loses_rows():
+    """Persistent spill-write failure (site directory.spill) must leave
+    every row RAM-resident and locatable — degraded, not lossy — and
+    recover to actual spilling once the fault clears."""
+    from ray_memory_management_tpu.ids import NodeID
+
+    faults.configure("directory.spill:error", seed=41)  # p=1, no budget
+    g = _bounded_gcs()
+    node = NodeID(b"n" * 16)
+    oids = _fill_directory(g, node, 400)
+    stats = g.directory_stats()
+    assert stats["cold"] == 0, "failed spills must not move rows cold"
+    assert stats["hot"] == 400
+    located = g.locate_objects(oids)
+    assert len(located) == 400  # every row still served
+    faults.reset()
+    # fault cleared + backoff expired (cold_s=0): next over-cap adds spill
+    _fill_directory(g, node, 200)
+    deadline = time.monotonic() + 5
+    while (g.directory_stats()["cold"] == 0
+           and time.monotonic() < deadline):
+        g.add_object_location(os.urandom(16), node, size=1)
+    assert g.directory_stats()["cold"] > 0
+    assert mdefs.gcs_directory_spills().get() > 0
+
+
+def test_directory_fault_read_failure_is_miss_not_loss():
+    """An injected cold-batch read failure (site directory.fault) must
+    surface as a lookup MISS while the blob and index stay intact, so
+    the next locate faults the row in bit-exact."""
+    from ray_memory_management_tpu.ids import NodeID
+
+    g = _bounded_gcs()
+    node = NodeID(b"m" * 16)
+    oids = _fill_directory(g, node, 400)
+    assert g.directory_stats()["cold"] > 0
+    cold_oid = next(o for sh in g._shards for o in sh.cold)
+    faults.configure("directory.fault:error:max=1", seed=42)
+    before = mdefs.gcs_directory_faults().get()
+    assert g.locate_objects([cold_oid]) == {}  # miss, not a crash
+    # retry with the budget exhausted: the batch faults in intact
+    located = g.locate_objects([cold_oid])
+    assert cold_oid in located
+    size, holders, tiers = located[cold_oid]
+    assert size == 64 and node in holders
+    assert mdefs.gcs_directory_faults().get() == before + 1
+    # and nothing was lost along the way: every row still resolvable
+    assert len(g.locate_objects(oids)) == 400
