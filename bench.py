@@ -551,6 +551,45 @@ def _profile_suite():
         return {"error": repr(e)}
 
 
+# Health-plane-suite fields every BENCH_DETAIL.json must carry
+# (tests/test_bench_format.py enforces the set): tasks/s on a plain
+# fan-out with the tsdb/rules plane on (RMT_HEALTH=1) vs off, the
+# overhead percentage the ISSUE caps at 5%, and the pod-scale store
+# footprint (RSS delta + per-tick rule-pack eval time).
+REQUIRED_HEALTH_FIELDS = (
+    "health_on_tasks_per_s", "health_off_tasks_per_s",
+    "health_overhead_pct", "store_rss_delta_mb", "rule_eval_ms",
+    "n_tasks", "trials", "sim_nodes", "n_rules",
+)
+
+
+def _health_suite():
+    """Health-plane overhead (utils/health_bench.py); fault-isolated so
+    a failure still reports the rest of the run."""
+    try:
+        from ray_memory_management_tpu.utils.health_bench import (
+            run_health_suite,
+        )
+
+        out = run_health_suite()
+        print(
+            f"  health fan-out ({out['n_tasks']} no-op tasks): "
+            f"{out['health_on_tasks_per_s']:.0f} tasks/s on vs "
+            f"{out['health_off_tasks_per_s']:.0f} off "
+            f"({out['health_overhead_pct']:+.1f}% overhead); "
+            f"store at {out['sim_nodes']} sim nodes: "
+            f"{out['store_rss_delta_mb']:.1f} MB RSS, "
+            f"{out['n_rules']}-rule eval {out['rule_eval_ms']:.2f} ms",
+            file=sys.stderr)
+        missing = [k for k in REQUIRED_HEALTH_FIELDS if k not in out]
+        if missing:
+            out["error"] = f"missing fields: {missing}"
+        return out
+    except Exception as e:  # pragma: no cover - keep the headline alive
+        print(f"  health suite failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 # Elastic-training contract surfaced in BENCH_DETAIL.json
 # (tests/test_bench_format.py enforces the set): steps/s with durability
 # off/sync/async, the step-blocking slice of one save in each mode (the
@@ -886,6 +925,7 @@ def main() -> None:
     tracing = _tracing_suite()
     logging_out = _logging_suite()
     profile = _profile_suite()
+    health = _health_suite()
     elastic = _elastic_suite()
     serve = _serve_suite()
     jobs = _jobs_suite()
@@ -903,7 +943,7 @@ def main() -> None:
               "transfer": transfer, "compression": compression,
               "locality": locality, "device": device,
               "tracing": tracing, "logging": logging_out,
-              "profile": profile, "elastic": elastic,
+              "profile": profile, "health": health, "elastic": elastic,
               "serve": serve, "jobs": jobs, "metrics": obs_metrics}
     import os
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -915,7 +955,7 @@ def main() -> None:
         print(f"  could not write {detail_path}: {e}", file=sys.stderr)
     for section in ("micro_stats", "scale", "scale_curve", "pod", "tpu",
                     "transfer", "compression", "locality", "device",
-                    "tracing", "logging", "profile", "elastic",
+                    "tracing", "logging", "profile", "health", "elastic",
                     "serve", "jobs", "metrics"):
         if detail.get(section):
             print(json.dumps({"detail": section, **{
@@ -924,15 +964,16 @@ def main() -> None:
     print(headline_line(results, stats, ratios, gm, memcpy_gbps, scale,
                         tpu, transfer, locality, tracing, elastic,
                         compression, logging=logging_out, device=device,
-                        profile=profile, scale_curve=scale_curve,
+                        profile=profile, health=health,
+                        scale_curve=scale_curve,
                         serve=serve, jobs=jobs, pod=pod))
 
 
 def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
                   transfer=None, locality=None, tracing=None,
                   elastic=None, compression=None, logging=None,
-                  device=None, profile=None, scale_curve=None,
-                  serve=None, jobs=None, pod=None):
+                  device=None, profile=None, health=None,
+                  scale_curve=None, serve=None, jobs=None, pod=None):
     """The ONE machine-facing stdout line: compact (<1 KB guaranteed)
     JSON carrying the geomean, the hw ceiling ratio, the mandated micro/
     scale rows, and the TPU north-star numbers."""
@@ -1034,6 +1075,12 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
         line["profile"] = {
             "overhead_pct": profile["profile_overhead_pct"],
         }
+    if health and "error" not in health:
+        # the health-plane acceptance number: plain fan-out overhead
+        # with the tsdb/rules plane sampling every tick (<=5%)
+        line["health"] = {
+            "overhead_pct": health["health_overhead_pct"],
+        }
     if compression and "error" not in compression:
         # the compressed-plane acceptance numbers: best-corpus speedup of
         # effective over the same-run uncompressed control, the chain's
@@ -1106,9 +1153,10 @@ def headline_line(results, stats, ratios, gm, memcpy_gbps, scale, tpu,
             line["tpu"] = t
     payload = json.dumps(line)
     if len(payload) > 1000:  # hard guarantee: never outgrow the tail window
-        for k in ("jobs", "serve", "profile", "compression", "elastic",
-                  "logging", "tracing", "device", "locality", "transfer",
-                  "micro", "pod_curve", "scale_curve", "scale"):
+        for k in ("jobs", "serve", "health", "profile", "compression",
+                  "elastic", "logging", "tracing", "device", "locality",
+                  "transfer", "micro", "pod_curve", "scale_curve",
+                  "scale"):
             line.pop(k, None)
             payload = json.dumps(line)
             if len(payload) <= 1000:

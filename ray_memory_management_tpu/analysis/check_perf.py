@@ -81,6 +81,7 @@ FIELD_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("tracing.overhead_pct", "down", 4.0),
     ("logging.overhead_pct", "down", 4.0),
     ("profile.overhead_pct", "down", 4.0),
+    ("health.overhead_pct", "down", 4.0),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

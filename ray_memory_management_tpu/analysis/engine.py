@@ -174,8 +174,8 @@ def run_checks(package_root: str, test_root: Optional[str] = None,
     # import the checker modules so they register (lazy: the analysis
     # package must stay importable without running anything)
     from . import (  # noqa: F401
-        check_faults, check_locks, check_logs, check_metrics,
-        check_protocol, check_trace,
+        check_faults, check_health, check_locks, check_logs,
+        check_metrics, check_protocol, check_trace,
     )
 
     project = Project(package_root, test_root)

@@ -382,6 +382,33 @@ _flag("profile_burst_hz", float, 97.0,
       "default, and the RMT_WORKER_PROFILE deprecation alias). Bursts "
       "are short and opt-in, so this trades overhead for resolution.")
 
+# --- observability: health plane ---------------------------------------------
+_flag("metrics_max_series_per_name", int, 256,
+      "Cardinality guard: max distinct tag-value combinations a single "
+      "metric name may hold in the registry. The first write past the "
+      "cap folds into an all-__other__ overflow series (counted by "
+      "rmt_metrics_series_overflow_total{metric}) so an unbounded "
+      "job_id/deployment tag space cannot grow the registry or the "
+      "Prometheus exposition forever. 0 disables the cap.")
+_flag("tsdb_raw_points", int, 600,
+      "Per-series raw ring size in the head's time-series store. At the "
+      "0.5s heartbeat tick, 600 points ~= 5 minutes of tick-resolution "
+      "history; the ring is a fixed-size deque, so head RSS is bounded "
+      "by construction.")
+_flag("tsdb_downsample_every", int, 10,
+      "Every N raw samples the tsdb folds them into one aggregate "
+      "(min/max/last/count) point in the downsampled ring, trading "
+      "resolution for horizon (10 ticks at 0.5s = one 5s point).")
+_flag("tsdb_downsample_points", int, 720,
+      "Per-series downsampled ring size: 720 aggregate points at one "
+      "per 5s ~= 1 hour of coarse history behind the raw window.")
+_flag("tsdb_max_series_per_name", int, 64,
+      "Per-name series cap inside the tsdb (tighter than the registry "
+      "guard: the store keeps history per series, not one float). "
+      "Samples for tag combos past the cap fold into an all-__other__ "
+      "bucket and are counted by rmt_tsdb_dropped_total{reason}. "
+      "0 disables the cap.")
+
 
 def _coerce(typ, raw: str):
     if typ is bool:

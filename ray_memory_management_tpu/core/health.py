@@ -1,0 +1,387 @@
+"""Declarative SLO/alert rules engine over the head tsdb.
+
+A rule is ``(expr over window, threshold, for_duration, severity)``:
+the expr is a small query tuple evaluated against utils/tsdb.py on
+every heartbeat tick —
+
+    ("rate",     series, window_s)      increments/s over the window
+    ("delta",    series, window_s)      increments over the window
+    ("value",    series)                last sampled value
+    ("quantile", series, q, window_s)   quantile_over_time
+
+— and the alert FIRES only after the expr has breached the threshold
+continuously for ``for_duration_s`` (hysteresis against one-tick
+spikes), then RESOLVES on the first non-breaching tick. Every
+transition is a structured ``events.emit(HEALTH_ALERT)`` plus a
+structlog record carrying the offending series' recent samples (the
+evidence window) and, when the runtime can attribute one, an exemplar
+task/trace id — so an alert pivots straight into ``rmt trace`` /
+``rmt logs`` / ``rmt profile``.
+
+The default rule pack covers the failure modes earlier PRs made
+countable; every series name it references must exist in
+``metrics_defs.DEFS`` (the ``alert-rule-registry`` rmtcheck rule fails
+``rmt check`` on drift). ``rmt doctor`` runs the same pack plus the
+static probes at the bottom of this module and prints a ranked
+diagnosis (scripts/cli.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import events as _events
+from ..utils import structlog as _structlog
+from ..utils import tsdb as _tsdb
+
+HEALTH_ALERT = "HEALTH_ALERT"
+
+# ranking order for doctor / get_alerts (higher = first)
+_SEVERITY_RANK = {"ERROR": 2, "WARNING": 1, "INFO": 0}
+
+_RESOLVED_KEEP = 256  # resolved-alert history ring
+
+
+class Rule:
+    """One declarative SLO rule. ``expr`` is a query tuple (module
+    docstring); ``cmp`` is ">" (breach above threshold) or "<"."""
+
+    def __init__(self, name: str, expr: Tuple, threshold: float,
+                 for_duration_s: float, severity: str,
+                 description: str = "", cmp: str = ">"):
+        if expr[0] not in ("rate", "delta", "value", "quantile"):
+            raise ValueError(f"unknown expr kind {expr[0]!r}")
+        if cmp not in (">", "<"):
+            raise ValueError("cmp must be '>' or '<'")
+        self.name = name
+        self.expr = expr
+        self.threshold = float(threshold)
+        self.for_duration_s = float(for_duration_s)
+        self.severity = severity
+        self.description = description
+        self.cmp = cmp
+
+    @property
+    def series(self) -> str:
+        return self.expr[1]
+
+    @property
+    def window_s(self) -> float:
+        if self.expr[0] == "value":
+            return 0.0
+        return float(self.expr[-1])
+
+    def describe_expr(self) -> str:
+        kind = self.expr[0]
+        if kind == "value":
+            return f"value({self.series})"
+        if kind == "quantile":
+            return (f"quantile({self.series}, q={self.expr[2]}, "
+                    f"{self.expr[3]:g}s)")
+        return f"{kind}({self.series}, {self.expr[2]:g}s)"
+
+
+def default_rules() -> List[Rule]:
+    """The shipped rule pack. Thresholds are deliberately low-water —
+    these are 'someone should look' signals, not paging SLOs — and
+    for_duration spans a few heartbeat ticks so a single bad tick
+    never fires."""
+    gib = 1024.0 ** 3
+    return [
+        Rule("task-failure-rate",
+             ("rate", "rmt_tasks_failed_total", 30.0), 0.5, 1.0, "ERROR",
+             "Tasks reaching FAILED (post-retry) faster than 0.5/s — "
+             "app errors, dead workers, or a poisoned node."),
+        Rule("serve-shed-rate",
+             ("rate", "rmt_serve_shed_total", 30.0), 0.5, 1.0, "WARNING",
+             "Serve requests shed (backpressure timeout / no replicas / "
+             "queue full) — capacity or routing problem."),
+        Rule("kv-backpressure",
+             ("rate", "rmt_serve_kv_backpressure_total", 30.0), 0.5, 1.0,
+             "WARNING",
+             "KV page-pool exhaustion deferring admissions — the paged "
+             "cache is at capacity; decode latency will follow."),
+        Rule("heartbeat-resyncs",
+             ("rate", "rmt_heartbeat_resyncs_total", 60.0), 0.2, 2.0,
+             "WARNING",
+             "Delta-heartbeat sequence gaps forcing full resyncs — "
+             "flaky agent channels or head overload."),
+        Rule("quota-throttle",
+             ("rate", "rmt_job_quota_rejections_total", 30.0), 0.5, 2.0,
+             "WARNING",
+             "Job quota rejections — some tenant is starved against its "
+             "object/device byte budget."),
+        Rule("spill-failures",
+             ("rate", "rmt_spill_errors_total", 60.0), 0.2, 2.0, "ERROR",
+             "Spill-storage IO errors — external storage degrading; "
+             "memory pressure relief is at risk."),
+        Rule("worker-exit-rate",
+             ("rate", "rmt_workers_exited_total", 30.0), 1.0, 2.0,
+             "WARNING",
+             "Worker processes exiting faster than 1/s — crash loop, "
+             "OOM kills, or churny preemption."),
+        Rule("head-rss-ceiling",
+             ("value", "rmt_proc_rss_bytes"), 8.0 * gib, 5.0, "ERROR",
+             "Head-process RSS past 8 GiB — control-plane state is "
+             "outgrowing the host; expect allocator stalls next."),
+    ]
+
+
+class HealthEngine:
+    """Evaluates a rule list against a TSDB on each tick and tracks
+    per-rule alert lifecycle (inactive -> breaching -> firing ->
+    resolved). The exemplar callback (wired by the runtime) maps a
+    firing rule to a {task_id, trace_id} pivot when one is
+    attributable."""
+
+    def __init__(self, store: _tsdb.TSDB,
+                 rules: Optional[List[Rule]] = None,
+                 exemplar: Optional[Callable[[Rule], Optional[dict]]]
+                 = None):
+        self._store = store
+        self._rules = list(default_rules() if rules is None else rules)
+        self._exemplar = exemplar
+        self._lock = threading.Lock()
+        # per rule-name: {"breach_since": ts|None, "alert": dict|None}
+        self._state: Dict[str, dict] = {}
+        self._resolved: deque = deque(maxlen=_RESOLVED_KEEP)
+
+    @property
+    def rules(self) -> List[Rule]:
+        return list(self._rules)
+
+    def eval_expr(self, rule: Rule,
+                  now: Optional[float] = None) -> Optional[float]:
+        kind = rule.expr[0]
+        s = self._store
+        if kind == "rate":
+            return s.rate(rule.series, rule.expr[2], now=now)
+        if kind == "delta":
+            return s.delta(rule.series, rule.expr[2], now=now)
+        if kind == "value":
+            return s.last(rule.series)
+        return s.quantile_over_time(rule.series, rule.expr[2],
+                                    rule.expr[3], now=now)
+
+    def _breaches(self, rule: Rule, value: Optional[float]) -> bool:
+        if value is None:
+            return False
+        if rule.cmp == ">":
+            return value > rule.threshold
+        return value < rule.threshold
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One tick: evaluate every rule, firing/resolving alerts.
+        Runs on the heartbeat thread; must never raise."""
+        ts = time.time() if now is None else now
+        for rule in self._rules:
+            try:
+                value = self.eval_expr(rule, now=now)
+            except Exception:
+                continue  # a broken expr must not stall its siblings
+            breach = self._breaches(rule, value)
+            with self._lock:
+                st = self._state.setdefault(
+                    rule.name, {"breach_since": None, "alert": None})
+                if breach:
+                    if st["breach_since"] is None:
+                        st["breach_since"] = ts
+                    alert = st["alert"]
+                    if alert is not None:
+                        alert["value"] = value  # keep it current
+                        continue
+                    if ts - st["breach_since"] < rule.for_duration_s:
+                        continue
+                    alert = self._make_alert(rule, value,
+                                             st["breach_since"], ts)
+                    st["alert"] = alert
+                else:
+                    st["breach_since"] = None
+                    alert = st["alert"]
+                    if alert is None:
+                        continue
+                    st["alert"] = None
+                    alert["state"] = "resolved"
+                    alert["resolved_ts"] = ts
+                    self._resolved.append(alert)
+            # emit OUTSIDE self._lock: events/structlog take their own
+            self._emit(rule, alert)
+
+    def _make_alert(self, rule: Rule, value: float, since: float,
+                    ts: float) -> dict:
+        evidence = self._store.tail(rule.series, n=8)
+        exemplar = None
+        if self._exemplar is not None:
+            try:
+                exemplar = self._exemplar(rule)
+            except Exception:
+                exemplar = None
+        return {
+            "rule": rule.name,
+            "severity": rule.severity,
+            "state": "firing",
+            "expr": rule.describe_expr(),
+            "series": rule.series,
+            "window_s": rule.window_s,
+            "for_duration_s": rule.for_duration_s,
+            "threshold": rule.threshold,
+            "value": value,
+            "breach_since": since,
+            "fired_ts": ts,
+            "resolved_ts": None,
+            "evidence": evidence,
+            "exemplar": exemplar,
+            "description": rule.description,
+        }
+
+    def _emit(self, rule: Rule, alert: dict) -> None:
+        state = alert["state"]
+        msg = (f"health alert {state}: {rule.name} "
+               f"({alert['expr']} = {alert['value']:g}, threshold "
+               f"{rule.cmp} {rule.threshold:g})")
+        severity = rule.severity if state == "firing" else _events.INFO
+        fields = {
+            "rule": rule.name, "state": state, "expr": alert["expr"],
+            "value": alert["value"], "threshold": rule.threshold,
+            "evidence": list(alert["evidence"]),
+        }
+        ex = alert.get("exemplar") or {}
+        if ex.get("task_id"):
+            fields["task_id"] = ex["task_id"]
+        if ex.get("trace_id"):
+            fields["trace_id"] = ex["trace_id"]
+        try:
+            _events.emit(HEALTH_ALERT, msg, severity=severity,
+                         source="health", **fields)
+        except Exception:
+            pass
+        try:
+            level = "INFO" if state == "resolved" else (
+                rule.severity if rule.severity in _structlog.LEVELS
+                else "WARNING")
+            _structlog.emit(level, msg, logger="rmt.health")
+        except Exception:
+            pass
+        try:
+            from . import metrics_defs as mdefs
+            mdefs.health_alerts().inc(
+                tags={"rule": rule.name, "severity": rule.severity})
+        except Exception:
+            pass
+
+    def alerts(self, state: Optional[str] = None,
+               limit: int = 100) -> List[dict]:
+        """Current + historical alerts, most severe first (then most
+        recent). ``state`` filters to 'firing' or 'resolved'."""
+        with self._lock:
+            firing = [dict(st["alert"]) for st in self._state.values()
+                      if st["alert"] is not None]
+            resolved = [dict(a) for a in self._resolved]
+        rows: List[dict] = []
+        if state in (None, "firing"):
+            rows.extend(firing)
+        if state in (None, "resolved"):
+            rows.extend(resolved)
+        rows.sort(key=lambda a: (
+            a["state"] != "firing",
+            -_SEVERITY_RANK.get(a["severity"], 0),
+            -(a["fired_ts"] or 0.0)))
+        return rows[: max(0, int(limit))]
+
+
+# -- static probes (rmt doctor) ------------------------------------------------
+# One-shot checks that don't fit the rate-over-window rule shape: direct
+# reads of runtime state plus recent-delta sniffs on the tsdb. Each
+# finding is {"probe", "severity", "summary"}; everything is defensive
+# getattr — doctor must degrade, never crash, on a partial runtime.
+
+def run_probes(rt: Any, store: _tsdb.TSDB) -> List[dict]:
+    findings: List[dict] = []
+    findings.extend(_probe_dead_nodes(rt))
+    findings.extend(_probe_stuck_leases(store))
+    findings.extend(_probe_unsealed_creates(store))
+    findings.extend(_probe_degraded_spill(store))
+    findings.extend(_probe_quota_starved(store))
+    findings.sort(key=lambda f: -_SEVERITY_RANK.get(f["severity"], 0))
+    return findings
+
+
+def _probe_dead_nodes(rt: Any) -> List[dict]:
+    try:
+        nodes = list(getattr(rt, "nodes", {}).values())
+        dead = [nm for nm in nodes if not getattr(nm, "alive", True)]
+    except Exception:
+        return []
+    if not dead:
+        return []
+    ids = ", ".join(
+        getattr(nm, "node_id", b"").hex()[:12] for nm in dead[:4])
+    return [{"probe": "dead-nodes", "severity": "ERROR",
+             "summary": f"{len(dead)} node(s) marked dead ({ids}); "
+                        "their leases were re-queued but capacity is "
+                        "gone until they rejoin."}]
+
+
+def _probe_stuck_leases(store: _tsdb.TSDB) -> List[dict]:
+    try:
+        depth = store.last("rmt_scheduler_queue_depth")
+        placed = store.rate("rmt_scheduler_placements_total", 60.0)
+        span = store.span("rmt_scheduler_placements_total", 60.0)
+    except Exception:
+        return []
+    if depth and depth > 0 and span >= 5.0 and placed == 0.0:
+        return [{"probe": "stuck-leases", "severity": "WARNING",
+                 "summary": f"dispatch queues hold {depth:g} task(s) "
+                            "but no placement landed in the last "
+                            f"{span:.0f}s — leases may be stuck on a "
+                            "wedged or saturated node."}]
+    return []
+
+
+def _probe_unsealed_creates(store: _tsdb.TSDB) -> List[dict]:
+    try:
+        d = store.delta("rmt_stale_creates_aborted_total", 300.0)
+    except Exception:
+        return []
+    if d > 0:
+        return [{"probe": "unsealed-creates", "severity": "WARNING",
+                 "summary": f"{d:g} unsealed create(s) aborted in the "
+                            "last 5 min — fetchers are dying between "
+                            "create and seal."}]
+    return []
+
+
+def _probe_degraded_spill(store: _tsdb.TSDB) -> List[dict]:
+    try:
+        entered = store.delta("rmt_spill_degraded_total", 300.0)
+        total = store.last("rmt_spill_degraded_total")
+    except Exception:
+        return []
+    if entered > 0:
+        return [{"probe": "degraded-spill", "severity": "ERROR",
+                 "summary": "the store entered spill-degraded mode in "
+                            "the last 5 min (persistent spill-storage "
+                            "failure) — objects are pinned in memory "
+                            "under backpressure."}]
+    if total and total > 0:
+        return [{"probe": "degraded-spill", "severity": "WARNING",
+                 "summary": f"spill-degraded mode has triggered "
+                            f"{total:g} time(s) this run — spill "
+                            "storage has a history of failing."}]
+    return []
+
+
+def _probe_quota_starved(store: _tsdb.TSDB) -> List[dict]:
+    try:
+        d = store.delta("rmt_job_quota_rejections_total", 300.0)
+    except Exception:
+        return []
+    if d > 0:
+        return [{"probe": "quota-starved-jobs", "severity": "WARNING",
+                 "summary": f"{d:g} quota rejection(s) in the last "
+                            "5 min — at least one job is starved "
+                            "against its byte budget."}]
+    return []

@@ -377,6 +377,27 @@ DEFS: Dict[str, tuple] = {
                     "stack, retention is head-side ProfileStore ring "
                     "eviction.",
         tag_keys=("reason",))),
+    # health plane (utils/tsdb.py + core/health.py)
+    "rmt_metrics_series_overflow_total": (Counter, dict(
+        description="Metric writes folded into the all-__other__ "
+                    "overflow series by the registry cardinality guard "
+                    "(a NEW distinct tag combo past metrics_max_series_"
+                    "per_name), by metric name.",
+        tag_keys=("metric",))),
+    "rmt_tsdb_dropped_total": (Counter, dict(
+        description="Time-series samples the head tsdb refused into a "
+                    "dedicated ring: cardinality is a tag combo past "
+                    "tsdb_max_series_per_name (the sample folds into "
+                    "the per-name __other__ bucket instead).",
+        tag_keys=("reason",))),
+    "rmt_workers_exited_total": (Counter, dict(
+        description="Worker processes that exited (clean or crashed) "
+                    "and were reaped by the head's death path; the "
+                    "health plane's worker-churn rate signal.")),
+    "rmt_health_alerts_total": (Counter, dict(
+        description="Health-rule alert transitions (firing + resolved), "
+                    "by rule and severity.",
+        tag_keys=("rule", "severity"))),
 }
 
 
@@ -732,3 +753,19 @@ def profile_bytes() -> Counter:
 
 def profile_dropped() -> Counter:
     return get("rmt_profile_dropped_total")
+
+
+def metrics_series_overflow() -> Counter:
+    return get("rmt_metrics_series_overflow_total")
+
+
+def tsdb_dropped() -> Counter:
+    return get("rmt_tsdb_dropped_total")
+
+
+def workers_exited() -> Counter:
+    return get("rmt_workers_exited_total")
+
+
+def health_alerts() -> Counter:
+    return get("rmt_health_alerts_total")

@@ -499,6 +499,20 @@ class Runtime:
         _profiler.configure(role="driver")
         _profiler.attach_store(self.profile_store)
         _profiler.start_sampler(hz=float(config.profile_hz))
+        # health plane: bounded time-series history over the head's
+        # merged registry (sampled on the heartbeat tick) + the SLO
+        # rules engine over it. Constructed even under RMT_HEALTH=0 so
+        # the query surfaces exist; the gate keeps the store empty.
+        from ..utils import tsdb as _tsdb
+        from .health import HealthEngine
+
+        self.tsdb = _tsdb.TSDB(
+            raw_points=config.tsdb_raw_points,
+            downsample_every=config.tsdb_downsample_every,
+            downsample_points=config.tsdb_downsample_points,
+            max_series_per_name=config.tsdb_max_series_per_name)
+        self.health = HealthEngine(self.tsdb,
+                                   exemplar=self._health_exemplar)
         # bounded per-resource samples from finished tasks' rusage deltas
         # (state.summarize_task_latencies resource percentiles)
         self.task_resources: Dict[str, deque] = {}
@@ -513,6 +527,7 @@ class Runtime:
         self._m_prefetch_completed = mdefs.prefetch_completed()
         self._m_leaf_placed = mdefs.sched_local_placed()
         self._m_leaf_spill = mdefs.sched_local_spillback()
+        self._m_worker_exits = mdefs.workers_exited()
         self._leaf_rr = 0  # round-robin cursor over nodes (router only)
         self._leaf_run = 0  # tasks placed on the cursor node this run (router only)
         # recoverable head state: sealed small objects WAL through the
@@ -2905,6 +2920,7 @@ class Runtime:
                     chan.cond.notify_all()  # retire its sender thread
         if dead_conn is not None and hasattr(dead_conn, "fileno"):
             self._wakeup()
+        self._m_worker_exits.inc()  # health plane's worker-churn signal
         nm = self.nodes.get(handle.node_id)
         if nm:
             nm.remove_worker(handle)
@@ -3432,6 +3448,10 @@ class Runtime:
                 self._refresh_gauges(nodes)
             except Exception:
                 pass  # sampling must never kill the heartbeat loop
+            try:
+                self._health_tick()
+            except Exception:
+                pass  # health plane must never kill the heartbeat loop
             if self.gcs.durable:
                 # directory shard snapshots ride the heartbeat cadence
                 # (~10 ticks): cheap enough to repeat, fresh enough that
@@ -3486,6 +3506,51 @@ class Runtime:
         dstats = self.gcs.directory_stats()
         mdefs.gcs_directory_hot_rows().set(float(dstats["hot"]))
         mdefs.gcs_directory_cold_rows().set(float(dstats["cold"]))
+
+    def _health_tick(self) -> None:
+        """Heartbeat-period health pass: snapshot the merged registry
+        into the tsdb rings, then run the SLO rules over the new
+        history. Both are no-ops under RMT_HEALTH=0 (the store stays
+        empty, so every rule expr evaluates to no-data)."""
+        from ..utils import tsdb as _tsdb
+
+        if not _tsdb.is_enabled():
+            return
+        self.tsdb.sample_registry()
+        self.health.evaluate()
+
+    def _health_exemplar(self, rule) -> Optional[dict]:
+        """Map a firing rule to a {task_id, trace_id} pivot: the most
+        recent FAILED task's trace for failure-shaped rules, else the
+        most recent traced task — 'when attributable', so None is a
+        valid answer on an idle cluster."""
+        want_failed = rule.name in ("task-failure-rate",
+                                    "worker-exit-rate")
+        best = None  # ((is_failed, ts), task_id, trace_ctx)
+        with self._lock:
+            for tid, rec in self.tasks.items():
+                ctx = rec.spec.trace_ctx
+                if not ctx:
+                    continue
+                ts = max(rec.ts.values()) if rec.ts else 0.0
+                score = (rec.state == "FAILED", ts)
+                if best is None or score > best[0]:
+                    best = (score, tid, ctx)
+            # history rows: (tid, name, state, num_returns, retries_left,
+            # is_actor, ts_map, trace_ctx, rusage), append-ordered —
+            # newest matching row wins
+            for row in reversed(self.task_history):
+                tid, state, ctx = row[0], row[2], row[7]
+                if not ctx or (want_failed and state != "FAILED"):
+                    continue
+                ts = max(row[6].values()) if row[6] else 0.0
+                score = (state == "FAILED", ts)
+                if best is None or score > best[0]:
+                    best = (score, tid, ctx)
+                break
+        if best is None or (want_failed and not best[0][0]):
+            return None
+        return {"task_id": best[1].hex(), "trace_id": best[2][0]}
 
     # --------------------------------------------------------- device objects
     def put_device_object(self, value: Any,

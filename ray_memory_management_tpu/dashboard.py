@@ -29,6 +29,13 @@ Routes::
                             filters: ?task_id=&trace_id=&node_id=
                             &since=&limit=&fold=&job_id=
                             (400 on bad params)
+    /api/series?name=       health-plane time-series history for one
+                            rmt_* metric; ?since=&window=&rate=&delta=
+                            &quantile= plus any other key=value as a
+                            tag filter (400 on bad params)
+    /api/alerts             SLO rules engine alerts (firing + resolved
+                            history); filters: ?state=&limit=
+                            (400 on bad params)
     /metrics                Prometheus exposition text
 """
 
@@ -255,6 +262,76 @@ class Dashboard:
                 # the view is a suffix — mirrors /api/logs
                 "dropped": _profiler.dropped_count(),
             }
+        elif path == "/api/series":
+            name = query.get("name")
+            if not name:
+                return (400, "application/json",
+                        b'{"error": "name query param required"}')
+            since = None
+            if "since" in query:
+                try:
+                    since = float(query["since"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "since must be a timestamp"}')
+            window = 60.0
+            if "window" in query:
+                try:
+                    window = float(query["window"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "window must be seconds"}')
+                if window <= 0:
+                    return (400, "application/json",
+                            b'{"error": "window must be > 0"}')
+            rate = delta = False
+            for key in ("rate", "delta"):
+                if key in query:
+                    raw = query[key].lower()
+                    if raw not in ("0", "1", "true", "false"):
+                        return (400, "application/json",
+                                json.dumps({"error": f"{key} must be "
+                                            "0/1/true/false"}).encode())
+                    if key == "rate":
+                        rate = raw in ("1", "true")
+                    else:
+                        delta = raw in ("1", "true")
+            quantile = None
+            if "quantile" in query:
+                try:
+                    quantile = float(query["quantile"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "quantile must be a number"}')
+                if not 0.0 <= quantile <= 1.0:
+                    return (400, "application/json",
+                            b'{"error": "quantile must be in [0, 1]"}')
+            # every remaining key=value is a tag filter (the series
+            # analog of /api/logs' id filters)
+            reserved = ("name", "since", "window", "rate", "delta",
+                        "quantile")
+            tags = {k: v for k, v in query.items() if k not in reserved}
+            data = state.query_series(
+                name, tags=tags or None, since=since, window=window,
+                rate=rate, delta=delta, quantile=quantile)
+        elif path == "/api/alerts":
+            alert_state = query.get("state")
+            if alert_state is not None and \
+                    alert_state not in ("firing", "resolved"):
+                return (400, "application/json",
+                        b'{"error": "state must be firing or resolved"}')
+            limit = 100
+            if "limit" in query:
+                try:
+                    limit = int(query["limit"])
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "limit must be an integer"}')
+                if limit < 0:
+                    return (400, "application/json",
+                            b'{"error": "limit must be >= 0"}')
+            data = {"alerts": state.get_alerts(state=alert_state,
+                                               limit=limit)}
         else:
             return 404, "application/json", b'{"error": "not found"}'
         return 200, "application/json", json.dumps(data).encode()

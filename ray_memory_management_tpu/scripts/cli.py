@@ -297,6 +297,81 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Ranked cluster diagnosis from the health plane (like ``logs``/
+    ``trace``, reads the in-process runtime — call main(['doctor'])
+    from a driver): re-evaluates the SLO rule pack against the tsdb,
+    runs the static probes (dead nodes, stuck leases, unsealed creates,
+    degraded spill, quota-starved jobs), and prints firing alerts first
+    — severity-ranked, each with its evidence window and (when
+    attributable) the exemplar trace id to pivot into ``rmt trace``/
+    ``rmt logs``/``rmt profile``."""
+    from ray_memory_management_tpu import _worker_context, state
+    from ray_memory_management_tpu.core import health as _health
+
+    rt = _worker_context.get_runtime()
+    if rt is None:
+        print("no cluster is running in this process "
+              "(call init() first, then rmt.scripts.cli.main(['doctor']))",
+              file=sys.stderr)
+        return 1
+    engine = getattr(rt, "health", None)
+    store = getattr(rt, "tsdb", None)
+    if engine is None or store is None:
+        print("health plane unavailable on this runtime", file=sys.stderr)
+        return 1
+    engine.evaluate()  # fresh pass so the diagnosis isn't one tick stale
+    alerts = state.get_alerts()
+    probes = _health.run_probes(rt, store)
+    # rule-pack point-in-time values round out the diagnosis (a rule
+    # under threshold still shows what it measured)
+    rules = []
+    for rule in engine.rules:
+        try:
+            value = engine.eval_expr(rule)
+        except Exception:
+            value = None
+        rules.append({"rule": rule.name, "expr": rule.describe_expr(),
+                      "value": value, "threshold": rule.threshold,
+                      "severity": rule.severity})
+    firing = [a for a in alerts if a["state"] == "firing"]
+    healthy = not firing and not probes
+    if args.json:
+        print(json.dumps({"healthy": healthy, "alerts": alerts,
+                          "probes": probes, "rules": rules}, indent=2))
+        return 0 if healthy else 1
+
+    def _fmt_val(v):
+        return "n/a" if v is None else f"{v:g}"
+
+    print("======== rmt doctor ========")
+    if healthy:
+        print("healthy: no firing alerts, no probe findings")
+    for i, a in enumerate(firing, 1):
+        print(f"{i}. [{a['severity']}] {a['rule']}: {a['expr']} = "
+              f"{_fmt_val(a['value'])} (threshold {a['threshold']:g}, "
+              f"held {a['for_duration_s']:g}s)")
+        if a.get("description"):
+            print(f"   {a['description']}")
+        ev = a.get("evidence") or []
+        if ev:
+            pts = ", ".join(f"{v:g}" for _, v in ev)
+            print(f"   evidence ({len(ev)} samples over "
+                  f"{ev[-1][0] - ev[0][0]:.1f}s): {pts}")
+        ex = a.get("exemplar") or {}
+        if ex.get("trace_id"):
+            print(f"   pivot: rmt trace {ex['trace_id']}"
+                  + (f"  (task {ex['task_id']})" if ex.get("task_id")
+                     else ""))
+    for f in probes:
+        print(f"-- [{f['severity']}] {f['probe']}: {f['summary']}")
+    print("---- rule pack ----")
+    for r in rules:
+        print(f"   {r['rule']:20s} {r['expr']:45s} "
+              f"{_fmt_val(r['value']):>12s} / {r['threshold']:g}")
+    return 0 if healthy else 1
+
+
 def cmd_microbenchmark(args) -> int:
     import ray_memory_management_tpu as rmt
     from ray_memory_management_tpu.utils.microbenchmark import (
@@ -535,6 +610,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write folded 'stack count' lines here instead "
                         "of stdout")
     s.set_defaults(fn=cmd_profile)
+
+    s = sub.add_parser(
+        "doctor",
+        help="ranked cluster diagnosis: run the health rule pack + "
+             "static probes and print firing alerts with evidence "
+             "(exit 1 when anything fires)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable JSON diagnosis")
+    s.set_defaults(fn=cmd_doctor)
 
     s = sub.add_parser("microbenchmark",
                        help="run the core microbenchmark suite")

@@ -6,6 +6,7 @@ plus the GCS global-state reads in ray._private.state.
 """
 
 from .api import (  # noqa: F401
+    get_alerts,
     get_logs,
     get_profile,
     get_trace,
@@ -17,6 +18,7 @@ from .api import (  # noqa: F401
     list_placement_groups,
     list_tasks,
     list_workers,
+    query_series,
     summarize_actors,
     summarize_critical_path,
     summarize_objects,

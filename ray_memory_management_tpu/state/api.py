@@ -452,6 +452,59 @@ def get_profile(node_id: Optional[str] = None,
             sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))]
 
 
+def query_series(name: str,
+                 tags: Optional[Dict[str, str]] = None,
+                 since: Optional[float] = None,
+                 window: float = 60.0,
+                 rate: bool = False,
+                 delta: bool = False,
+                 quantile: Optional[float] = None) -> Dict[str, Any]:
+    """Query the health plane's time-series store (utils/tsdb.py): the
+    heartbeat-tick history of one ``rmt_*`` metric. ``series`` holds
+    per-tag-combo point lists ``[[ts, value], ...]`` (coarse downsampled
+    history first, then the raw tick-resolution ring; ``tags`` is a
+    subset match, ``since`` a ts lower bound). ``rate=True`` /
+    ``delta=True`` / ``quantile=q`` additionally evaluate the named
+    aggregate over the trailing ``window`` seconds — ``delta`` is the
+    exact counted increments between the window's first and last
+    samples, and ``rate * span_s == delta`` by construction. Empty
+    under ``RMT_HEALTH=0`` (the store never filled)."""
+    rt = _runtime()
+    store = getattr(rt, "tsdb", None)
+    if store is None:
+        return {"name": name, "series": []}
+    out: Dict[str, Any] = {
+        "name": name,
+        "series": store.range(name, tags=tags, since=since),
+    }
+    if rate or delta:
+        out["span_s"] = store.span(name, window, tags=tags)
+    if rate:
+        out["rate"] = store.rate(name, window, tags=tags)
+    if delta:
+        out["delta"] = store.delta(name, window, tags=tags)
+    if quantile is not None:
+        out["quantile"] = store.quantile_over_time(
+            name, quantile, window, tags=tags)
+    return out
+
+
+def get_alerts(state: Optional[str] = None,
+               limit: int = 100) -> List[Dict[str, Any]]:
+    """Query the SLO rules engine (core/health.py): currently-firing
+    alerts plus the bounded resolved history, most severe first. Each
+    row carries the rule, its expr/threshold/observed value, the
+    evidence samples (``[[ts, value], ...]`` of the offending series),
+    and — when the runtime could attribute one — an exemplar
+    task_id/trace_id that pivots into get_trace/get_logs/get_profile.
+    ``state`` filters to ``"firing"`` or ``"resolved"``."""
+    rt = _runtime()
+    engine = getattr(rt, "health", None)
+    if engine is None:
+        return []
+    return engine.alerts(state=state, limit=limit)
+
+
 # Critical-path attribution: stage -> transition-stamp intervals, listed
 # in PRIORITY order. A wall-clock instant covered by several overlapping
 # intervals (a sibling executing while another waits in queue) is charged

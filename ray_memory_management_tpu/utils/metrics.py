@@ -28,6 +28,48 @@ def _tag_key(tags: Optional[Dict[str, str]],
     return tuple(sorted(merged.items()))
 
 
+# -- cardinality guard ---------------------------------------------------------
+# An unbounded tag space (job ids, deployments, 256 node ids) would grow a
+# metric's series dict — and the Prometheus exposition — forever. The first
+# write that would create a distinct tag combo past the per-name cap folds
+# into an all-__other__ series instead, counted by
+# rmt_metrics_series_overflow_total{metric}.
+
+OVERFLOW_TAG_VALUE = "__other__"
+
+_series_cap_override: Optional[int] = None
+
+
+def set_series_cap(cap: Optional[int]) -> None:
+    """Test hook: override ``metrics_max_series_per_name`` process-wide
+    (None restores the config value)."""
+    global _series_cap_override
+    _series_cap_override = cap
+
+
+def _series_cap() -> int:
+    if _series_cap_override is not None:
+        return _series_cap_override
+    try:
+        from ..config import global_config
+        return int(global_config().metrics_max_series_per_name)
+    except Exception:
+        return 0  # config unavailable (import-order edge): no cap
+
+
+def _note_series_overflow(name: str) -> None:
+    """Count one folded write. The overflow counter's own tag space is the
+    set of metric NAMES (bounded by the registry), and its own folds are
+    skipped, so this cannot recurse."""
+    if name == "rmt_metrics_series_overflow_total":
+        return
+    try:
+        from ..core import metrics_defs as mdefs
+        mdefs.metrics_series_overflow().inc(tags={"metric": name})
+    except Exception:
+        pass  # guard accounting must never fail a metric write
+
+
 class Metric:
     """Base: name, help text, declared tag keys, default tag values."""
 
@@ -91,6 +133,24 @@ class Metric:
                         f"{self._name!r}"
                     )
 
+    def _key_store(self) -> dict:
+        """The dict whose keys are this instrument's distinct tag combos
+        (subclass storage; what the cardinality guard counts)."""
+        raise NotImplementedError
+
+    def _admit_key(self, key: TagKey) -> Tuple[TagKey, bool]:
+        """Cardinality guard, called under self._lock by every mutator:
+        an already-present combo or one under the cap passes through; a
+        NEW combo past the cap folds to the all-__other__ overflow key.
+        Returns (key to store under, whether it was folded)."""
+        store = self._key_store()
+        if key in store:
+            return key, False
+        cap = _series_cap()
+        if cap <= 0 or len(store) < cap:
+            return key, False
+        return tuple((k, OVERFLOW_TAG_VALUE) for k, _ in key), True
+
 
 class Counter(Metric):
     """Monotonic counter (util/metrics.py:155)."""
@@ -103,6 +163,9 @@ class Counter(Metric):
     def _share_state(self, other: "Counter") -> None:
         self._values = other._values
 
+    def _key_store(self) -> dict:
+        return self._values
+
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None) -> None:
         if value <= 0:
@@ -110,7 +173,10 @@ class Counter(Metric):
         self._check_tags(tags)
         key = _tag_key(tags, self._default_tags)
         with self._lock:
+            key, folded = self._admit_key(key)
             self._values[key] = self._values.get(key, 0.0) + value
+        if folded:
+            _note_series_overflow(self._name)
 
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
         key = _tag_key(tags, self._default_tags)
@@ -133,11 +199,17 @@ class Gauge(Metric):
     def _share_state(self, other: "Gauge") -> None:
         self._values = other._values
 
+    def _key_store(self) -> dict:
+        return self._values
+
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         self._check_tags(tags)
         key = _tag_key(tags, self._default_tags)
         with self._lock:
+            key, folded = self._admit_key(key)
             self._values[key] = float(value)
+        if folded:
+            _note_series_overflow(self._name)
 
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
         key = _tag_key(tags, self._default_tags)
@@ -178,11 +250,15 @@ class Histogram(Metric):
         self._sums = other._sums
         self._totals = other._totals
 
+    def _key_store(self) -> dict:
+        return self._counts
+
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
         self._check_tags(tags)
         key = _tag_key(tags, self._default_tags)
         with self._lock:
+            key, folded = self._admit_key(key)
             counts = self._counts.setdefault(
                 key, [0] * (len(self._boundaries) + 1))
             idx = len(self._boundaries)
@@ -193,6 +269,8 @@ class Histogram(Metric):
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+        if folded:
+            _note_series_overflow(self._name)
 
     def get(self, tags: Optional[Dict[str, str]] = None) -> dict:
         key = _tag_key(tags, self._default_tags)
@@ -261,6 +339,14 @@ def export_prometheus() -> str:
                 lines.append(f"{name}_sum{_fmt_tags(key)} {total_sum}")
                 lines.append(f"{name}_count{_fmt_tags(key)} {count}")
     return "\n".join(lines) + "\n"
+
+
+def registry_metrics() -> List["Metric"]:
+    """Registry iteration hook: a snapshot list of every registered
+    instrument (the tsdb samples these on the heartbeat tick; each
+    instrument's ``series()`` is its own consistent snapshot)."""
+    with _registry_lock:
+        return list(_registry.values())
 
 
 def clear_registry() -> None:
@@ -339,32 +425,43 @@ def merge_series(snapshots: List[dict]) -> None:
     instrument lock (counter deltas add, gauge values overwrite, histogram
     bucket deltas add)."""
     for snap in snapshots or ():
+        folds = 0
         try:
             kind = snap["kind"]
             name = snap["name"]
             desc = snap.get("description", "")
             keys = tuple(snap.get("tag_keys") or ())
+            # the merge is where pod-scale tag fan-out lands on the head,
+            # so the cardinality guard applies here exactly as in inc()
             if kind == "counter":
                 m = Counter(name, desc, tag_keys=keys)
                 with m._lock:
                     for key, d in snap["series"].items():
+                        key, folded = m._admit_key(key)
+                        folds += folded
                         m._values[key] = m._values.get(key, 0.0) + d
             elif kind == "gauge":
                 m = Gauge(name, desc, tag_keys=keys)
                 with m._lock:
                     for key, v in snap["series"].items():
+                        key, folded = m._admit_key(key)
+                        folds += folded
                         m._values[key] = float(v)
             elif kind == "histogram":
                 m = Histogram(name, desc,
                               boundaries=snap["boundaries"], tag_keys=keys)
                 with m._lock:
                     for key, (dc, dsum, dtotal) in snap["series"].items():
+                        key, folded = m._admit_key(key)
+                        folds += folded
                         cur = m._counts.setdefault(
                             key, [0] * (len(m._boundaries) + 1))
                         for i, c in enumerate(dc):
                             cur[i] += c
                         m._sums[key] = m._sums.get(key, 0.0) + dsum
                         m._totals[key] = m._totals.get(key, 0) + dtotal
+            for _ in range(folds):
+                _note_series_overflow(name)
         except (KeyError, ValueError, TypeError):
             # malformed frame or a name/type clash with a head-registered
             # metric: drop that one series, never poison the router thread

@@ -1,0 +1,412 @@
+"""Head-side bounded time-series store for the health plane.
+
+Every registered ``rmt_*`` instrument is sampled on the existing
+heartbeat tick (core/runtime.py _heartbeat_loop) into fixed-size ring
+windows per series: a raw ring at tick resolution (~5 min at the 0.5s
+tick) and a downsampled ring of min/max/last aggregates behind it
+(~1 h). Prometheus-style historical queries — ``range``, ``rate``,
+``delta``, ``quantile_over_time`` — run over those rings; the SLO rules
+engine (core/health.py) and ``rmt doctor`` are the consumers, and
+ROADMAP item 5's autotuner is the intended third.
+
+Bounded by construction: rings are fixed-size deques, metric names are
+bounded by the registry (core/metrics_defs.py), and distinct tag combos
+per name are capped at ``tsdb_max_series_per_name`` — combos past the
+cap fold into a per-name ``__other__`` bucket (aggregated, not lost)
+and the displaced dedicated samples are counted by
+``rmt_tsdb_dropped_total{reason=cardinality}``. Pod-scale tag fan-out
+(256 nodes x job ids x deployments) therefore costs O(cap) rings per
+name, never O(combos).
+
+``RMT_HEALTH=0`` disables sampling in every process (the store stays
+empty), mirroring the ``RMT_LOGS`` / ``RMT_PROFILE`` plane gates.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+TagKey = Tuple[Tuple[str, str], ...]
+
+OVERFLOW_TAG_VALUE = "__other__"
+
+# -- plane gate (mirrors utils/structlog.py / utils/profiler.py) --------------
+_enabled = os.environ.get("RMT_HEALTH", "1") != "0"
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+# lazy drop-counter (the structlog _instruments() pattern: metrics_defs
+# imports utils.metrics, so the hop back must not run at import time)
+_m_dropped = None
+
+
+def _dropped_counter():
+    global _m_dropped
+    if _m_dropped is None:
+        from ..core import metrics_defs as mdefs
+        _m_dropped = mdefs.tsdb_dropped()
+    return _m_dropped
+
+
+class _Series:
+    """One tag combo's history: raw ring of (ts, value) plus a coarse
+    downsampled ring of (ts, vmin, vmax, vlast, n) aggregates. Histogram
+    values are (counts_tuple, sum, total) cumulative snapshots; their
+    downsampled aggregate keeps only the last snapshot per bucket."""
+
+    __slots__ = ("raw", "down", "pending")
+
+    def __init__(self, raw_points: int, down_points: int):
+        self.raw: Deque[Tuple[float, Any]] = deque(maxlen=raw_points)
+        self.down: Deque[tuple] = deque(maxlen=down_points)
+        self.pending = 0  # raw ingests since the last downsample fold
+
+
+class _Name:
+    """All series sharing one metric name (+ its kind and, for
+    histograms, the bucket boundaries seen at sample time)."""
+
+    __slots__ = ("kind", "series", "boundaries")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.series: Dict[TagKey, _Series] = {}
+        self.boundaries: Optional[List[float]] = None
+
+
+def _match(key: TagKey, tags: Optional[Dict[str, str]]) -> bool:
+    if not tags:
+        return True
+    kv = dict(key)
+    return all(kv.get(k) == str(v) for k, v in tags.items())
+
+
+def _scalar(kind: str, value: Any) -> float:
+    # histograms surface as their cumulative observation count in
+    # scalar queries (rate over it = observations/s)
+    if kind == "histogram":
+        return float(value[2])
+    return float(value)
+
+
+class TSDB:
+    """The bounded store. All mutation happens under one lock on the
+    heartbeat thread; queries take the same lock and copy out."""
+
+    def __init__(self, raw_points: int = 600, downsample_every: int = 10,
+                 downsample_points: int = 720,
+                 max_series_per_name: int = 64):
+        self._lock = threading.Lock()
+        self._raw_points = max(2, int(raw_points))
+        self._down_every = max(1, int(downsample_every))
+        self._down_points = max(1, int(downsample_points))
+        self._max_series = int(max_series_per_name)
+        self._names: Dict[str, _Name] = {}
+
+    # -- ingest ---------------------------------------------------------------
+
+    def sample_registry(self, now: Optional[float] = None) -> None:
+        """One tick: snapshot every registered instrument into the
+        rings. No-op when the plane is disabled (RMT_HEALTH=0)."""
+        if not _enabled:
+            return
+        ts = time.time() if now is None else now
+        dropped: Dict[str, int] = {}
+        for m in _metrics.registry_metrics():
+            if isinstance(m, _metrics.Counter):
+                kind = "counter"
+            elif isinstance(m, _metrics.Gauge):
+                kind = "gauge"
+            elif isinstance(m, _metrics.Histogram):
+                kind = "histogram"
+            else:
+                continue
+            snap = m.series()
+            if not snap:
+                continue
+            name = m.info["name"]
+            boundaries = list(m._boundaries) if kind == "histogram" else None
+            with self._lock:
+                n = self._ingest_snapshot(name, kind, boundaries, snap, ts)
+            if n:
+                dropped[name] = dropped.get(name, 0) + n
+        if dropped:
+            try:
+                total = sum(dropped.values())
+                _dropped_counter().inc(float(total),
+                                       tags={"reason": "cardinality"})
+            except Exception:
+                pass  # drop accounting must never fail the tick
+
+    def ingest(self, name: str, kind: str, snap: Dict[TagKey, Any],
+               ts: float, boundaries: Optional[List[float]] = None) -> int:
+        """Test/bench entry: ingest one instrument snapshot directly.
+        Returns the number of over-cap combos folded this call."""
+        with self._lock:
+            return self._ingest_snapshot(name, kind, boundaries, snap, ts)
+
+    def _ingest_snapshot(self, name: str, kind: str,
+                         boundaries: Optional[List[float]],
+                         snap: Dict[TagKey, Any], ts: float) -> int:
+        nm = self._names.get(name)
+        if nm is None:
+            nm = self._names[name] = _Name(kind)
+        if boundaries is not None:
+            nm.boundaries = boundaries
+        # partition the snapshot: combos with (or admissible to) a
+        # dedicated ring vs the over-cap remainder, which is SUMMED into
+        # the __other__ bucket — cumulative counters/histograms stay
+        # monotonic because ring admission is first-come and stable
+        overflow: List[Any] = []
+        for key, value in snap.items():
+            s = nm.series.get(key)
+            if s is None:
+                if self._max_series > 0 and \
+                        len(nm.series) >= self._max_series:
+                    overflow.append(value)
+                    continue
+                s = nm.series[key] = _Series(self._raw_points,
+                                             self._down_points)
+            self._push(nm, s, ts, value)
+        if overflow:
+            okey: TagKey = ((("__series__", OVERFLOW_TAG_VALUE),)
+                            if not nm.series else
+                            tuple((k, OVERFLOW_TAG_VALUE)
+                                  for k, _ in next(iter(nm.series))))
+            s = nm.series.get(okey)
+            if s is None:
+                s = nm.series[okey] = _Series(self._raw_points,
+                                              self._down_points)
+            self._push(nm, s, ts, self._fold(kind, overflow))
+        return len(overflow)
+
+    @staticmethod
+    def _fold(kind: str, values: List[Any]) -> Any:
+        if kind == "histogram":
+            counts = [0] * len(values[0][0])
+            total_sum, total = 0.0, 0
+            for c, ssum, stotal in values:
+                for i, v in enumerate(c):
+                    if i < len(counts):
+                        counts[i] += v
+                total_sum += ssum
+                total += stotal
+            return (counts, total_sum, total)
+        return float(sum(values))
+
+    def _push(self, nm: _Name, s: _Series, ts: float, value: Any) -> None:
+        s.raw.append((ts, value))
+        s.pending += 1
+        if s.pending >= self._down_every:
+            s.pending = 0
+            window = list(s.raw)[-self._down_every:]
+            if nm.kind == "histogram":
+                s.down.append((ts, window[-1][1]))
+            else:
+                vals = [float(v) for _, v in window]
+                s.down.append((ts, min(vals), max(vals), vals[-1],
+                               len(vals)))
+
+    # -- queries --------------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._names)
+
+    def stats(self) -> Dict[str, int]:
+        """{"names", "series", "points"} — the whole store's footprint
+        in one dict (tests assert emptiness / boundedness on it)."""
+        with self._lock:
+            series = sum(len(nm.series) for nm in self._names.values())
+            points = sum(len(s.raw) + len(s.down)
+                         for nm in self._names.values()
+                         for s in nm.series.values())
+            return {"names": len(self._names), "series": series,
+                    "points": points}
+
+    def _select(self, name: str, tags: Optional[Dict[str, str]]
+                ) -> List[Tuple[TagKey, _Series, str]]:
+        nm = self._names.get(name)
+        if nm is None:
+            return []
+        return [(key, s, nm.kind) for key, s in nm.series.items()
+                if _match(key, tags)]
+
+    def range(self, name: str, tags: Optional[Dict[str, str]] = None,
+              since: Optional[float] = None) -> List[dict]:
+        """Per-series scalar points, downsampled history first (last-
+        value per aggregate, only where it predates the raw ring) then
+        the raw ring. Histograms surface their cumulative count."""
+        out: List[dict] = []
+        with self._lock:
+            for key, s, kind in self._select(name, tags):
+                raw = list(s.raw)
+                oldest_raw = raw[0][0] if raw else math.inf
+                pts: List[List[float]] = []
+                for d in s.down:
+                    if d[0] >= oldest_raw:
+                        continue
+                    v = _scalar(kind, d[1]) if kind == "histogram" \
+                        else float(d[3])
+                    if since is None or d[0] >= since:
+                        pts.append([d[0], v])
+                for ts, v in raw:
+                    if since is None or ts >= since:
+                        pts.append([ts, _scalar(kind, v)])
+                out.append({"tags": dict(key), "points": pts})
+        return out
+
+    def down(self, name: str, tags: Optional[Dict[str, str]] = None
+             ) -> List[dict]:
+        """Downsampled-ring contents per matching series (tests assert
+        aggregate correctness on these)."""
+        out = []
+        with self._lock:
+            for key, s, kind in self._select(name, tags):
+                out.append({"tags": dict(key), "points": list(s.down)})
+        return out
+
+    def _window_points(self, name: str, tags: Optional[Dict[str, str]],
+                       window: float, now: Optional[float]
+                       ) -> List[Tuple[List[Tuple[float, Any]], str]]:
+        # walk each ring right-to-left and stop at the window edge: the
+        # rule engine queries small windows (30-60s) against rings that
+        # hold ~5 min x up-to-cap series, so copying whole rings per
+        # eval would dominate the heartbeat tick
+        out: List[Tuple[List[Tuple[float, Any]], str]] = []
+        with self._lock:
+            sel = self._select(name, tags)
+            if now is None:
+                now = max((s.raw[-1][0] for _, s, _ in sel if s.raw),
+                          default=time.time())
+            lo = now - window
+            for _, s, kind in sel:
+                pts: List[Tuple[float, Any]] = []
+                for ts, v in reversed(s.raw):
+                    if ts < lo:
+                        break
+                    pts.append((ts, v))
+                pts.reverse()
+                out.append((pts, kind))
+        return out
+
+    def delta(self, name: str, window: float = 60.0,
+              tags: Optional[Dict[str, str]] = None,
+              now: Optional[float] = None) -> float:
+        """Sum over matching series of (last - first) within the window
+        — for sampled cumulative counters this is EXACTLY the counted
+        increments between the two ticks."""
+        total = 0.0
+        for pts, kind in self._window_points(name, tags, window, now):
+            if len(pts) >= 2:
+                total += _scalar(kind, pts[-1][1]) - _scalar(kind, pts[0][1])
+        return total
+
+    def rate(self, name: str, window: float = 60.0,
+             tags: Optional[Dict[str, str]] = None,
+             now: Optional[float] = None) -> float:
+        """delta / covered-span (per-second). The span is what the
+        samples actually cover, so rate * span == delta exactly."""
+        total, best = 0.0, 0.0
+        for pts, kind in self._window_points(name, tags, window, now):
+            if len(pts) >= 2:
+                total += _scalar(kind, pts[-1][1]) \
+                    - _scalar(kind, pts[0][1])
+                best = max(best, pts[-1][0] - pts[0][0])
+        return total / best if best > 0 else 0.0
+
+    def span(self, name: str, window: float = 60.0,
+             tags: Optional[Dict[str, str]] = None,
+             now: Optional[float] = None) -> float:
+        """Seconds actually covered by samples inside the window (max
+        across matching series; 0 when fewer than two samples)."""
+        best = 0.0
+        for pts, _ in self._window_points(name, tags, window, now):
+            if len(pts) >= 2:
+                best = max(best, pts[-1][0] - pts[0][0])
+        return best
+
+    def last(self, name: str, tags: Optional[Dict[str, str]] = None
+             ) -> Optional[float]:
+        with self._lock:
+            sel = self._select(name, tags)
+            vals = [(s.raw[-1][0], _scalar(kind, s.raw[-1][1]))
+                    for _, s, kind in sel if s.raw]
+        if not vals:
+            return None
+        return max(vals)[1]
+
+    def tail(self, name: str, tags: Optional[Dict[str, str]] = None,
+             n: int = 5) -> List[List[float]]:
+        """Last n scalar points across matching series, merged by
+        timestamp — the evidence window alerts carry."""
+        pts: List[List[float]] = []
+        with self._lock:
+            for _, s, kind in self._select(name, tags):
+                pts.extend([ts, _scalar(kind, v)] for ts, v in s.raw)
+        pts.sort(key=lambda p: p[0])
+        return pts[-n:]
+
+    def quantile_over_time(self, name: str, q: float,
+                           window: float = 60.0,
+                           tags: Optional[Dict[str, str]] = None,
+                           now: Optional[float] = None) -> Optional[float]:
+        """Histograms: interpolated quantile of the observations made
+        WITHIN the window (cumulative bucket deltas, summed across
+        matching series). Scalars: nearest-rank percentile of the raw
+        samples in the window."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        windows = self._window_points(name, tags, window, now)
+        if not windows:
+            return None
+        if windows[0][1] == "histogram":
+            with self._lock:
+                nm = self._names.get(name)
+                boundaries = list(nm.boundaries or []) if nm else []
+            dcounts: Optional[List[float]] = None
+            for pts, _ in windows:
+                if len(pts) < 2:
+                    continue
+                first, last = pts[0][1], pts[-1][1]
+                d = [la - fa for la, fa in zip(last[0], first[0])]
+                if dcounts is None:
+                    dcounts = d
+                else:
+                    dcounts = [a + b for a, b in zip(dcounts, d)]
+            if not dcounts or sum(dcounts) <= 0:
+                return None
+            target = q * sum(dcounts)
+            edges = boundaries + [boundaries[-1] if boundaries else 0.0]
+            cum = 0.0
+            lo_edge = 0.0
+            for i, c in enumerate(dcounts):
+                if cum + c >= target and c > 0:
+                    hi_edge = edges[i] if i < len(edges) else lo_edge
+                    frac = (target - cum) / c
+                    return lo_edge + (hi_edge - lo_edge) * frac
+                cum += c
+                if i < len(boundaries):
+                    lo_edge = boundaries[i]
+            return lo_edge
+        vals = sorted(_scalar(kind, v)
+                      for pts, kind in windows for _, v in pts)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+        return vals[idx]

@@ -1,0 +1,10 @@
+"""Fixture: alert-rule-registry seeds (rule naming a missing series)."""
+
+RULES = [
+    ("rate", "rmt_fixture_used_total", 30.0),
+    ("rate", "rmt_fixture_missing_total", 30.0),  # SEEDED: alert-rule-registry
+]
+
+
+def suppressed_rule():
+    return ("value", "rmt_fixture_also_missing")  # rmtcheck: disable=alert-rule-registry

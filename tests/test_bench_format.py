@@ -304,6 +304,52 @@ def test_bench_detail_snapshot_has_profile_section(bench):
         assert not missing, missing
 
 
+def test_health_suite_reports_required_fields(bench):
+    """The health suite must emit every field the BENCH_DETAIL.json
+    contract names (on/off tasks-per-s, overhead pct, pod-scale store
+    footprint) — run a mini-sized pass so CI proves the real code path,
+    not a fixture."""
+    from ray_memory_management_tpu.utils.health_bench import (
+        run_health_suite,
+    )
+
+    out = run_health_suite(n_tasks=16, trials=1, sim_nodes=16, n_rules=3)
+    missing = [k for k in bench.REQUIRED_HEALTH_FIELDS if k not in out]
+    assert not missing, missing
+    assert out["health_on_tasks_per_s"] > 0
+    assert out["health_off_tasks_per_s"] > 0
+    assert out["rule_eval_ms"] >= 0
+    assert out["store_points"] > 0  # the rings actually filled
+
+
+def test_headline_line_carries_health_summary(bench):
+    results, stats, ratios, scale, tpu = _bloated_inputs()
+    health = {"health_overhead_pct": 1.4}
+    payload = bench.headline_line(results, stats, ratios, 3.02, 11.56,
+                                  scale, tpu, health=health)
+    assert len(payload) <= 1000
+    line = json.loads(payload)
+    if "health" in line:  # may be popped only by the <1KB guard
+        assert line["health"]["overhead_pct"] == 1.4
+
+
+def test_bench_detail_snapshot_has_health_section(bench):
+    """An existing BENCH_DETAIL.json snapshot (written by a full bench
+    run) must carry the health section with the required fields."""
+    path = os.path.join(os.path.dirname(_BENCH), "BENCH_DETAIL.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_DETAIL.json snapshot in repo")
+    with open(path) as f:
+        detail = json.load(f)
+    health = detail.get("health")
+    if health is None:
+        pytest.skip("snapshot predates the health section")
+    if "error" not in health:
+        missing = [k for k in bench.REQUIRED_HEALTH_FIELDS
+                   if k not in health]
+        assert not missing, missing
+
+
 def test_elastic_suite_reports_required_fields(bench):
     """The elastic-training suite must emit every field the
     BENCH_DETAIL.json contract names (steps/s off/sync/async, blocking

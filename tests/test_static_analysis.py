@@ -25,9 +25,9 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 FIXTURE_PKG = os.path.join(HERE, "analysis_fixtures", "pkg")
 FIXTURE_TESTS = os.path.join(HERE, "analysis_fixtures", "pkgtests")
 
-RULES = ("blocking-under-lock", "fault-site", "lock-discipline",
-         "log-discipline", "metric-registry", "protocol-additivity",
-         "trace-propagation")
+RULES = ("alert-rule-registry", "blocking-under-lock", "fault-site",
+         "lock-discipline", "log-discipline", "metric-registry",
+         "protocol-additivity", "trace-propagation")
 
 
 # --------------------------------------------------------------- the tree
@@ -77,6 +77,14 @@ def test_fixture_metric_registry_fires(fixture_violations):
     assert any("'color'" in m for m in msgs)               # undeclared tag
     assert any("rmt_fixture_unused_total" in m for m in msgs)  # drift
     assert not any("also_not_a_series" in m for m in msgs)  # pragma
+
+
+def test_fixture_alert_rule_registry_fires(fixture_violations):
+    msgs = [v.message for v in _hits(fixture_violations,
+                                     "alert-rule-registry")]
+    assert any("rmt_fixture_missing_total" in m for m in msgs)  # seed
+    assert not any("rmt_fixture_used_total" in m for m in msgs)  # declared
+    assert not any("rmt_fixture_also_missing" in m for m in msgs)  # pragma
 
 
 def test_fixture_fault_site_fires(fixture_violations):
